@@ -1,0 +1,24 @@
+"""TPU-native attribute-based access control (ABAC) framework.
+
+A brand-new, TPU-first framework with the capabilities of the
+restorecommerce/access-control-srv reference (XACML-inspired PDP/PRP/PAP):
+
+- ``models``   -- the policy/request data model (PolicySet -> Policy -> Rule
+  trees, Targets, Attributes, Effects) and the URN vocabulary.
+- ``core``     -- the scalar policy-decision oracle: a pure-Python engine
+  implementing the normative decision semantics (reference:
+  src/core/accessController.ts).  It is the correctness oracle for the
+  compiled evaluator and the fallback path for requests the tensor kernel
+  cannot represent.
+- ``ops``      -- the TPU evaluator: string interner, policy compiler
+  (tree -> integer/bool tensors), request batch encoder and the jitted,
+  vmapped decision kernel.
+- ``parallel`` -- device-mesh sharding of the request batch axis
+  (jax.sharding / shard_map); policy tensors are replicated, requests are
+  data-parallel, decisions ride ICI collectives.
+- ``srv``      -- the serving shell: policy store with CRUD + hot recompile,
+  command interface, subject / hierarchical-scope cache, micro-batching
+  frontend and transports (reference: src/worker.ts, src/resourceManager.ts).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,204 @@
+"""Rule condition evaluation.
+
+The reference evaluates rule conditions as raw JavaScript via ``eval`` with
+``target`` and ``context`` in scope, calling the result if it is a function
+(reference: src/core/utils.ts:47-56 — arbitrary code, trusted-policy
+assumption).  This framework treats policy documents as *less* trusted:
+conditions are **restricted Python** validated against an AST whitelist
+before evaluation:
+
+- only expression/comprehension/lambda/def-of-``check`` constructs;
+- no imports, no ``exec``/``eval``/``compile``/``getattr`` calls;
+- no dunder or underscore-prefixed attribute or name access (blocks the
+  ``().__class__.__base__.__subclasses__()`` escape family).
+
+A condition is either a single expression over ``request`` / ``target`` /
+``context``, or a multi-line snippet defining
+``check(request, target, context)``.  Failures during validation or
+evaluation propagate as exceptions; the engine converts them into
+deny-by-default responses (reference: src/core/accessController.ts:259-270).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any
+
+
+class DotView:
+    """Attribute-style read-only view over nested dicts/lists so conditions
+    can be written ``context.resources[0].address`` against JSON-like
+    context data.  Missing attributes raise, mirroring the reference where a
+    broken condition throws inside ``eval`` and yields DENY."""
+
+    __slots__ = ("_obj",)
+
+    def __init__(self, obj: Any):
+        object.__setattr__(self, "_obj", obj)
+
+    def __getattr__(self, name: str):
+        obj = object.__getattribute__(self, "_obj")
+        if isinstance(obj, dict):
+            if name in obj:
+                return _wrap(obj[name])
+            raise AttributeError(f"context has no attribute {name!r}")
+        return _wrap(getattr(obj, name))
+
+    def __getitem__(self, key):
+        return _wrap(object.__getattribute__(self, "_obj")[key])
+
+    def __iter__(self):
+        return (_wrap(x) for x in object.__getattribute__(self, "_obj"))
+
+    def __len__(self):
+        return len(object.__getattribute__(self, "_obj"))
+
+    def __contains__(self, item):
+        return item in object.__getattribute__(self, "_obj")
+
+    def __eq__(self, other):
+        mine = object.__getattribute__(self, "_obj")
+        if isinstance(other, DotView):
+            other = object.__getattribute__(other, "_obj")
+        return mine == other
+
+    def __bool__(self):
+        return bool(object.__getattribute__(self, "_obj"))
+
+    def __repr__(self):
+        return f"DotView({object.__getattribute__(self, '_obj')!r})"
+
+    def raw(self):
+        return object.__getattribute__(self, "_obj")
+
+
+def _wrap(value: Any):
+    if isinstance(value, (dict, list)):
+        return DotView(value) if isinstance(value, dict) else [_wrap(v) for v in value]
+    return value
+
+
+_SAFE_BUILTINS = {
+    "len": len,
+    "any": any,
+    "all": all,
+    "min": min,
+    "max": max,
+    "sum": sum,
+    "sorted": sorted,
+    "str": str,
+    "int": int,
+    "float": float,
+    "bool": bool,
+    "list": list,
+    "dict": dict,
+    "set": set,
+    "tuple": tuple,
+    "enumerate": enumerate,
+    "zip": zip,
+    "range": range,
+    "isinstance": isinstance,
+    "abs": abs,
+    "True": True,
+    "False": False,
+    "None": None,
+}
+
+_ALLOWED_STATEMENTS = (
+    ast.Expr,
+    ast.Assign,
+    ast.AugAssign,
+    ast.AnnAssign,
+    ast.Return,
+    ast.If,
+    ast.For,
+    ast.While,
+    ast.Break,
+    ast.Continue,
+    ast.Pass,
+    ast.FunctionDef,
+)
+
+_BANNED_CALL_NAMES = {
+    "eval", "exec", "compile", "__import__", "open", "getattr", "setattr",
+    "delattr", "globals", "locals", "vars", "breakpoint", "input", "type",
+    "object", "super", "memoryview", "bytearray", "classmethod",
+    "staticmethod", "property",
+}
+
+
+class ConditionValidationError(ValueError):
+    code = 500
+
+
+def _validate_condition_ast(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            raise ConditionValidationError("imports are not allowed in conditions")
+        if isinstance(node, (ast.Global, ast.Nonlocal, ast.ClassDef,
+                             ast.AsyncFunctionDef, ast.Await, ast.Yield,
+                             ast.YieldFrom, ast.Try, ast.Raise, ast.With,
+                             ast.AsyncWith, ast.AsyncFor, ast.Delete)):
+            raise ConditionValidationError(
+                f"{type(node).__name__} is not allowed in conditions"
+            )
+        if isinstance(node, ast.stmt) and not isinstance(node, _ALLOWED_STATEMENTS):
+            raise ConditionValidationError(
+                f"statement {type(node).__name__} is not allowed in conditions"
+            )
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise ConditionValidationError(
+                f"access to {node.attr!r} is not allowed in conditions"
+            )
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise ConditionValidationError(
+                f"name {node.id!r} is not allowed in conditions"
+            )
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _BANNED_CALL_NAMES:
+                raise ConditionValidationError(
+                    f"calling {fn.id!r} is not allowed in conditions"
+                )
+
+
+def condition_matches(condition: str, request) -> bool:
+    """Evaluate ``condition`` for ``request``; truthy result means the rule's
+    condition holds.  May raise on malformed conditions / contexts."""
+
+    condition = condition.replace("\\n", "\n")
+    target = request.target
+    context = request.context
+    # a single namespace (globals) so comprehension/generator scopes inside
+    # the evaluated expression still see request/target/context
+    env = {
+        "__builtins__": dict(_SAFE_BUILTINS),
+        "request": request,
+        "target": target,
+        "context": _wrap(context) if isinstance(context, (dict, list)) else context,
+        "re": re,
+    }
+
+    try:
+        tree = ast.parse(condition, mode="eval")
+        is_expression = True
+    except SyntaxError:
+        tree = ast.parse(condition, mode="exec")
+        is_expression = False
+    _validate_condition_ast(tree)
+
+    if is_expression:
+        result = eval(compile(tree, "<condition>", "eval"), env)
+    else:
+        exec(compile(tree, "<condition>", "exec"), env)
+        check = env.get("check")
+        if not callable(check):
+            raise ConditionValidationError(
+                "multi-line condition must define check(request, target, context)"
+            )
+        return bool(check(request, env["target"], env["context"]))
+
+    if callable(result):
+        return bool(result(request, env["target"], env["context"]))
+    return bool(result)

@@ -1,0 +1,102 @@
+"""Golden decision tests over no-target policies + combining algorithms
+(scalar oracle; decision matrix mirrors the reference engine semantics,
+src/core/accessController.ts:88-324)."""
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+ADDR = "urn:restorecommerce:acs:model:address.Address"
+READ = URNS["read"]
+MODIFY = URNS["modify"]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return make_engine("basic_policies.yml")
+
+
+def check(engine, expected, **kwargs):
+    defaults = dict(
+        subject_role="member",
+        role_scoping_entity=ORG,
+        role_scoping_instance="Org1",
+        resource_property=ORG + "#name",
+    )
+    defaults.update(kwargs)
+    response = engine.is_allowed(build_request(**defaults))
+    assert response.decision == expected
+    assert response.operation_status.code == 200
+    return response
+
+
+def test_permit_subject_rule(engine):
+    check(engine, Decision.PERMIT, subject_id="ada", resource_type=ORG,
+          resource_id="Ada Inc", action_type=READ)
+
+
+def test_deny_subject_rule(engine):
+    check(engine, Decision.DENY, subject_id="ben", resource_type=ORG,
+          resource_id="Ben Inc", action_type=READ)
+
+
+def test_deny_modify_rule(engine):
+    check(engine, Decision.DENY, subject_id="ada", resource_type=ORG,
+          resource_id="Ada Inc", action_type=MODIFY)
+
+
+def test_indeterminate_unmatched_action(engine):
+    check(engine, Decision.INDETERMINATE, subject_id="ben", resource_type=ORG,
+          resource_id="Ben Inc", action_type=MODIFY)
+
+
+def test_indeterminate_unknown_subject(engine):
+    check(engine, Decision.INDETERMINATE, subject_id="zoe", resource_type=ORG,
+          resource_id="Zoe Inc", action_type=MODIFY)
+
+
+def test_indeterminate_unknown_entity(engine):
+    check(
+        engine,
+        Decision.INDETERMINATE,
+        subject_id="ada",
+        resource_type="urn:restorecommerce:acs:model:widget.Widget",
+        resource_property="urn:restorecommerce:acs:model:widget.Widget#prop",
+        resource_id="W1",
+        action_type=READ,
+    )
+
+
+def test_permit_overrides(engine):
+    check(engine, Decision.PERMIT, subject_id="gil", resource_type=ORG,
+          resource_id="Gil GmbH", action_type=READ)
+
+
+def test_deny_overrides(engine):
+    check(engine, Decision.DENY, subject_id="dee", resource_type=USER,
+          resource_property=USER + "#password", resource_id="dee", action_type=READ)
+
+
+def test_first_applicable_deny(engine):
+    check(engine, Decision.DENY, subject_id="eva", resource_type=ADDR,
+          resource_property=ADDR + "#street", resource_id="Main St", action_type=READ)
+
+
+def test_first_applicable_permit(engine):
+    # the deny rule targets read; a modify only collects the blanket permit
+    check(engine, Decision.PERMIT, subject_id="eva", resource_type=ADDR,
+          resource_property=ADDR + "#street", resource_id="Main St",
+          action_type=MODIFY)
+
+
+def test_no_target_denies():
+    from access_control_srv_tpu.models import Request
+
+    engine = make_engine("basic_policies.yml")
+    response = engine.is_allowed(Request(target=None, context={}))
+    assert response.decision == Decision.DENY
+    assert response.operation_status.code == 400

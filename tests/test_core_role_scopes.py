@@ -1,0 +1,148 @@
+"""Golden tests for role scoping: direct + hierarchical owner matching,
+multi-entity requests (sticky entity-match quirk), operation/execute
+targets, HR-disabled rules and conditions."""
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+LOC = "urn:restorecommerce:acs:model:location.Location"
+READ = URNS["read"]
+MODIFY = URNS["modify"]
+EXECUTE = URNS["execute"]
+
+
+def check(engine, expected, **kwargs):
+    defaults = dict(
+        subject_role="member",
+        role_scoping_entity=ORG,
+        role_scoping_instance="Org1",
+    )
+    defaults.update(kwargs)
+    request = build_request(**defaults)
+    response = engine.is_allowed(request)
+    assert response.decision == expected, kwargs
+    return response
+
+
+class TestRoleScopes:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("role_scopes.yml")
+
+    def test_permit_member_read_location(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=LOC,
+              resource_id="L1", action_type=READ,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+    def test_permit_multi_entity_read(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada",
+              resource_type=[LOC, ORG], resource_id=["L1", "O1"],
+              action_type=READ, owner_indicatory_entity=ORG,
+              owner_instance=["Org1", "Org1"])
+
+    def test_deny_multi_entity_owner_mismatch(self, engine):
+        check(engine, Decision.DENY, subject_id="ada",
+              resource_type=[LOC, ORG], resource_id=["L1", "O1"],
+              action_type=READ, owner_indicatory_entity=ORG,
+              owner_instance=["Org1", "otherOrg"])
+
+    def test_deny_member_modify_location(self, engine):
+        check(engine, Decision.DENY, subject_id="ada", resource_type=LOC,
+              resource_id="L1", action_type=MODIFY,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+    def test_permit_manager_modify_location(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", subject_role="manager",
+              role_scoping_instance="SuperOrg1", resource_type=LOC,
+              resource_id="L1", action_type=MODIFY,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+    def test_deny_manager_foreign_org(self, engine):
+        # HR scopes restricted to Org2 subtree; owner Org1 is outside it
+        check(engine, Decision.DENY, subject_id="ada", subject_role="manager",
+              role_scoping_instance="Org2", resource_type=LOC, resource_id="L1",
+              action_type=MODIFY, owner_indicatory_entity=ORG,
+              owner_instance="Org1",
+              hierarchical_scopes=[{"id": "Org2", "children": [{"id": "Org3"}]}])
+
+    def test_permit_manager_execute(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", subject_role="manager",
+              resource_type="mutation.runPipeline",
+              resource_id="mutation.runPipeline", action_type=EXECUTE,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+    def test_deny_manager_execute_foreign_org(self, engine):
+        check(engine, Decision.DENY, subject_id="ada", subject_role="manager",
+              role_scoping_instance="Org2",
+              resource_type="mutation.runPipeline",
+              resource_id="mutation.runPipeline", action_type=EXECUTE,
+              owner_indicatory_entity=ORG, owner_instance="Org1",
+              hierarchical_scopes=[{"id": "Org2", "role": "manager",
+                                     "children": [{"id": "Org3"}]}])
+
+    def test_deny_member_execute(self, engine):
+        check(engine, Decision.DENY, subject_id="ada",
+              resource_type="mutation.runPipeline",
+              resource_id="mutation.runPipeline", action_type=EXECUTE,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+
+class TestHRDisabled:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("hr_disabled.yml")
+
+    def test_permit_direct_scope(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=LOC,
+              resource_id="L1", action_type=READ,
+              owner_indicatory_entity=ORG, owner_instance="Org1")
+
+    def test_deny_hierarchical_scope_disabled(self, engine):
+        # owner Org2 is inside the HR subtree of Org1 but HR matching is off
+        check(engine, Decision.DENY, subject_id="ada", resource_type=LOC,
+              resource_id="L1", action_type=READ,
+              owner_indicatory_entity=ORG, owner_instance="Org2")
+
+
+class TestConditions:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("conditions.yml")
+
+    def test_deny_modify_other_account(self, engine):
+        check(engine, Decision.DENY, subject_id="ada", resource_type=USER,
+              resource_id="not-ada", action_type=MODIFY)
+
+    def test_permit_modify_own_account(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=USER,
+              resource_id="ada", action_type=MODIFY)
+
+    def test_deny_invalid_context(self, engine):
+        # with no context at all the role-gated rules can't match; the
+        # fallback deny rule still applies (status stays 200)
+        request = build_request(
+            subject_id="ada", subject_role="member",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=USER, resource_id="ada", action_type=MODIFY,
+        )
+        request.context = None
+        response = engine.is_allowed(request)
+        assert response.decision == Decision.DENY
+
+    def test_deny_condition_exception(self, engine):
+        # a context that lets the conditional rule match but makes its
+        # condition raise -> deny-by-default with an error status
+        request = build_request(
+            subject_id="ada", subject_role="member",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=USER, resource_id="ada", action_type=MODIFY,
+        )
+        del request.context["resources"]
+        response = engine.is_allowed(request)
+        assert response.decision == Decision.DENY
+        assert response.operation_status.code == 500

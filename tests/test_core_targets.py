@@ -1,0 +1,102 @@
+"""Golden tests for policy-level and policy-set-level targets, property
+rules, bare-effect policies and HR owner matching."""
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+ADDR = "urn:restorecommerce:acs:model:address.Address"
+LOC = "urn:restorecommerce:acs:model:location.Location"
+READ = URNS["read"]
+MODIFY = URNS["modify"]
+
+
+def check(engine, expected, **kwargs):
+    defaults = dict(
+        subject_role="member",
+        role_scoping_entity=ORG,
+        role_scoping_instance="Org1",
+    )
+    defaults.update(kwargs)
+    response = engine.is_allowed(build_request(**defaults))
+    assert response.decision == expected, kwargs
+    return response
+
+
+class TestPolicyTargets:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("policy_targets.yml")
+
+    def test_permit_read_secret(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ben", resource_type=ORG,
+              resource_property=ORG + "#secret_field", resource_id="Ben GmbH",
+              action_type=READ)
+
+    def test_deny_modify_secret(self, engine):
+        check(engine, Decision.DENY, subject_id="ben", resource_type=ORG,
+              resource_property=ORG + "#secret_field", resource_id="Ben GmbH",
+              action_type=MODIFY)
+
+    def test_policy_combining_permits_ada(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=ORG,
+              resource_property=ORG + "#secret_field", resource_id="Ada GmbH",
+              action_type=MODIFY)
+
+    def test_indeterminate_out_of_policy_target(self, engine):
+        check(engine, Decision.INDETERMINATE, subject_id="ada", resource_type=USER,
+              resource_property=USER + "#password", resource_id="ada",
+              action_type=MODIFY)
+
+    def test_permit_street_rule(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=ADDR,
+              resource_property=ADDR + "#street", resource_id="Main St",
+              action_type=MODIFY)
+
+    def test_permit_bare_effect_policy(self, engine):
+        check(engine, Decision.PERMIT, subject_id="dee", resource_type=ORG,
+              resource_property=ORG + "#name", resource_id="Dee Inc",
+              action_type=READ)
+
+
+class TestPolicySetTargets:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("policy_set_targets.yml")
+
+    def test_permit_read_org(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", resource_type=ORG,
+              resource_property=ORG + "#name", resource_id="O1", action_type=READ)
+
+    def test_indeterminate_user_for_member(self, engine):
+        check(engine, Decision.INDETERMINATE, subject_id="ada", resource_type=USER,
+              resource_property=USER + "#name", resource_id="ben", action_type=READ)
+
+    def test_deny_modify_org(self, engine):
+        check(engine, Decision.DENY, subject_id="ben", resource_type=ORG,
+              resource_property=ORG + "#name", resource_id="O1", action_type=MODIFY)
+
+    def test_permit_guest_read_user(self, engine):
+        check(engine, Decision.PERMIT, subject_id="kai", subject_role="guest",
+              resource_type=USER, resource_property=USER + "#name",
+              resource_id="ben", action_type=READ)
+
+    def test_deny_guest_modify_user(self, engine):
+        check(engine, Decision.DENY, subject_id="kai", subject_role="guest",
+              resource_type=USER, resource_property=USER + "#name",
+              resource_id="ben", action_type=MODIFY)
+
+    def test_indeterminate_owner_outside_hr_scope(self, engine):
+        check(engine, Decision.INDETERMINATE, subject_id="ada",
+              subject_role="manager", resource_type=LOC, resource_id="L1",
+              action_type=MODIFY, owner_indicatory_entity=ORG,
+              owner_instance="Org4")
+
+    def test_permit_owner_in_hr_scope(self, engine):
+        check(engine, Decision.PERMIT, subject_id="ada", subject_role="manager",
+              resource_type=LOC, resource_id="L1", action_type=MODIFY,
+              owner_indicatory_entity=ORG, owner_instance="Org2")

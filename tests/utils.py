@@ -1,0 +1,197 @@
+"""Test helpers: request builder + engine construction.
+
+The builder produces the canonical request/context wire shape the service
+receives after protobuf-Any unmarshalling (modeled on the reference test
+harness, test/utils.ts buildRequest): subject attributes are
+[role, subject-id]; resources are (entity, resource-id, properties...) runs
+or operation attributes for execute actions; context carries resource meta
+(owners, acls) and the subject's role associations + hierarchical scopes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Union
+
+from access_control_srv_tpu.core import AccessController, populate
+from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+URNS = Urns()
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def make_engine(fixture_name: Optional[str] = None, **kwargs) -> AccessController:
+    engine = AccessController(**kwargs)
+    if fixture_name:
+        populate(engine, fixture(fixture_name))
+    return engine
+
+
+def _listify(value) -> list:
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+def build_request(
+    subject_id: Optional[str] = None,
+    subject_role: Optional[str] = None,
+    role_scoping_entity: Optional[str] = None,
+    role_scoping_instance: Union[str, Sequence[str], None] = None,
+    resource_type: Union[str, Sequence[str], None] = None,
+    resource_id: Union[str, Sequence[str], None] = None,
+    resource_property: Union[str, Sequence, None] = None,
+    action_type: Optional[str] = None,
+    owner_indicatory_entity: Optional[str] = None,
+    owner_instance: Union[str, Sequence[str], None] = None,
+    acl_indicatory_entity: Optional[str] = None,
+    acl_instances: Optional[Sequence[str]] = None,
+    multiple_acl_indicatory_entity: Optional[Sequence[str]] = None,
+    org_instances: Optional[Sequence[str]] = None,
+    subject_instances: Optional[Sequence[str]] = None,
+    hierarchical_scopes: Optional[list] = None,
+) -> Request:
+    subjects = [
+        Attribute(id=URNS["role"], value=subject_role or "member"),
+        Attribute(id=URNS["subjectID"], value=subject_id or ""),
+    ]
+
+    resources: list[Attribute] = []
+    types = _listify(resource_type)
+    ids = _listify(resource_id)
+    props = _listify(resource_property)
+
+    if action_type == URNS["execute"]:
+        for operation_name in types:
+            resources.append(Attribute(id=URNS["operation"], value=operation_name))
+    else:
+        for i, rtype in enumerate(types):
+            resources.append(Attribute(id=URNS["entity"], value=rtype))
+            resources.append(
+                Attribute(id=URNS["resourceID"], value=ids[i] if i < len(ids) else "")
+            )
+            for prop in props:
+                if isinstance(prop, str):
+                    resources.append(Attribute(id=URNS["property"], value=prop))
+                else:
+                    # nested per-entity property lists: keep only properties
+                    # belonging to this entity
+                    entity_name = rtype.rsplit(":", 1)[-1]
+                    for p in prop:
+                        if entity_name in p:
+                            resources.append(Attribute(id=URNS["property"], value=p))
+
+    actions = [Attribute(id=URNS["actionID"], value=action_type or "")]
+
+    acls: list[dict] = []
+    if acl_indicatory_entity and acl_instances:
+        acls = [
+            {
+                "id": URNS["aclIndicatoryEntity"],
+                "value": acl_indicatory_entity,
+                "attributes": [
+                    {"id": URNS["aclInstance"], "value": inst}
+                    for inst in acl_instances
+                ],
+            }
+        ]
+    elif multiple_acl_indicatory_entity and org_instances and subject_instances:
+        acls = [
+            {
+                "id": URNS["aclIndicatoryEntity"],
+                "value": multiple_acl_indicatory_entity[0],
+                "attributes": [
+                    {"id": URNS["aclInstance"], "value": inst}
+                    for inst in org_instances
+                ],
+            },
+            {
+                "id": URNS["aclIndicatoryEntity"],
+                "value": multiple_acl_indicatory_entity[1],
+                "attributes": [
+                    {"id": URNS["aclInstance"], "value": inst}
+                    for inst in subject_instances
+                ],
+            },
+        ]
+
+    owner_instances = _listify(owner_instance)
+    ctx_resources: list[dict] = []
+    for i, rid in enumerate(ids if action_type != URNS["execute"] else types):
+        owners = []
+        if owner_indicatory_entity and owner_instances:
+            inst = (
+                owner_instances[i]
+                if i < len(owner_instances)
+                else owner_instances[-1]
+            )
+            owners = [
+                {
+                    "id": URNS["ownerIndicatoryEntity"],
+                    "value": owner_indicatory_entity,
+                    "attributes": [
+                        {"id": URNS["ownerInstance"], "value": inst}
+                    ],
+                }
+            ]
+        ctx_resources.append({"id": rid, "meta": {"owners": owners, "acls": acls}})
+
+    role_associations = []
+    if subject_role and role_scoping_entity and role_scoping_instance:
+        role_associations = [
+            {
+                "role": subject_role,
+                "attributes": [
+                    {
+                        "id": URNS["roleScopingEntity"],
+                        "value": role_scoping_entity,
+                        "attributes": [
+                            {
+                                "id": URNS["roleScopingInstance"],
+                                "value": inst,
+                            }
+                            for inst in _listify(role_scoping_instance)
+                        ],
+                    }
+                ],
+            }
+        ]
+
+    if hierarchical_scopes is None:
+        hierarchical_scopes = (
+            [
+                {
+                    "id": "SuperOrg1",
+                    "role": subject_role,
+                    "children": [
+                        {
+                            "id": "Org1",
+                            "children": [
+                                {"id": "Org2", "children": [{"id": "Org3"}]}
+                            ],
+                        }
+                    ],
+                }
+            ]
+            if role_scoping_entity and role_scoping_instance
+            else []
+        )
+
+    return Request(
+        target=Target(subjects=subjects, resources=resources, actions=actions),
+        context={
+            "resources": ctx_resources,
+            "subject": {
+                "id": subject_id,
+                "role_associations": role_associations,
+                "hierarchical_scopes": hierarchical_scopes,
+            },
+        },
+    )
