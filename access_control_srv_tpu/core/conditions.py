@@ -132,6 +132,24 @@ class ConditionValidationError(ValueError):
     code = 500
 
 
+class _SafeRegex:
+    """Bool-returning regex helpers for conditions.  The raw ``re`` module
+    (or Match objects, whose ``.re`` attribute leads back to module
+    globals) must never enter the condition namespace."""
+
+    @staticmethod
+    def search(pattern: str, string: str) -> bool:
+        return re.search(pattern, string) is not None
+
+    @staticmethod
+    def match(pattern: str, string: str) -> bool:
+        return re.match(pattern, string) is not None
+
+    @staticmethod
+    def fullmatch(pattern: str, string: str) -> bool:
+        return re.fullmatch(pattern, string) is not None
+
+
 def _validate_condition_ast(tree: ast.AST) -> None:
     for node in ast.walk(tree):
         if isinstance(node, (ast.Import, ast.ImportFrom)):
@@ -177,7 +195,7 @@ def condition_matches(condition: str, request) -> bool:
         "request": request,
         "target": target,
         "context": _wrap(context) if isinstance(context, (dict, list)) else context,
-        "re": re,
+        "re": _SafeRegex,
     }
 
     try:

@@ -50,7 +50,11 @@ from ..models.urns import Urns
 from . import errors
 from .common import get_field as _get
 from .conditions import condition_matches
-from .hierarchical_scope import check_hierarchical_scope, split_entity_urn
+from .hierarchical_scope import (
+    check_hierarchical_scope,
+    regex_entity_compare,
+    split_entity_urn,
+)
 from .verify_acl import verify_acl_list
 
 DEFAULT_COMBINING_ALGORITHMS = [
@@ -273,18 +277,16 @@ class AccessController:
                                                 (cq.filters and len(cq.filters))
                                                 or (cq.query and len(cq.query))
                                             ):
+                                                # always a merged object, even
+                                                # for empty adapter results —
+                                                # the reference's nil-check deny
+                                                # branch (:240-251) is dead code
+                                                # because merge() never yields
+                                                # nil (:959-965); adapter errors
+                                                # surface as exceptions below
                                                 pulled = self.pull_context_resources(
                                                     cq, request
                                                 )
-                                                if pulled is None:
-                                                    # empty context query result:
-                                                    # deny by default (ref :240-251)
-                                                    return Response(
-                                                        decision=Decision.DENY,
-                                                        obligations=obligations,
-                                                        evaluation_cacheable=evaluation_cacheable,
-                                                        operation_status=OperationStatus(),
-                                                    )
                                             if pulled is not None:
                                                 request.context = pulled
                                             matches = condition_matches(
@@ -670,21 +672,14 @@ class AccessController:
                     ):
                         # regex entity matching with namespace verification
                         # (ref :526-566)
-                        rule_ns, entity_regex, rule_prefix = split_entity_urn(
-                            rule_attribute.value
+                        request_entity_urn = request_attribute.value or ""
+                        set_flag, prefix_mismatch = regex_entity_compare(
+                            rule_attribute.value, request_attribute.value
                         )
-                        req_value = request_attribute.value or ""
-                        request_entity_urn = req_value
-                        req_ns, req_entity, req_prefix = split_entity_urn(req_value)
-                        if req_prefix != rule_prefix:
+                        if prefix_mismatch:
                             entity_match = False
-                        if (req_ns and rule_ns and req_ns == rule_ns) or (
-                            not req_ns and not rule_ns
-                        ):
-                            if req_entity is not None and re.search(
-                                entity_regex, req_entity
-                            ):
-                                entity_match = True
+                        if set_flag:
+                            entity_match = True
                     elif (
                         entity_match
                         and request_attribute.id == property_urn
@@ -903,14 +898,12 @@ class AccessController:
 
     def pull_context_resources(self, context_query, request: Request):
         """Query the resource adapter and graft the result onto a merged
-        request view under ``_queryResult`` (reference: :959-965 — note the
-        reference assigns the *merged request* into ``request.context``)."""
+        request view under ``_queryResult`` (reference: :959-965 — the
+        reference assigns the *merged request* into ``request.context`` and
+        the merge never yields nil, even for empty adapter results)."""
         result = self.resource_adapter.query(context_query, request)
-        if result is None:
-            return None
-        merged = {
+        return {
             "target": request.target,
             "context": request.context,
             "_queryResult": result,
         }
-        return merged

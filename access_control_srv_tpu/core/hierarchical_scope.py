@@ -43,6 +43,24 @@ def split_entity_urn(value: str) -> tuple[Optional[str], str, str]:
     return ns, entity_value, prefix
 
 
+def regex_entity_compare(rule_value: str, req_value: str) -> tuple[bool, bool]:
+    """The reference's regex-branch entity comparison, shared by the
+    matcher, the HR-scope check and the batch encoder (reference:
+    accessController.ts:526-566 / hierarchicalScope.ts:64-102).
+
+    Returns ``(set_flag, prefix_mismatch)``: the caller updates its sticky
+    entity-match state as ``set_flag ? True : (prefix_mismatch ? False :
+    state)`` — a regex hit wins over the prefix reset, mirroring the
+    reference statement order.  Invalid regex patterns propagate (the
+    reference's ``new RegExp`` throws; the service layer denies)."""
+    rule_ns, rule_regex, rule_prefix = split_entity_urn(rule_value)
+    req_ns, req_entity, req_prefix = split_entity_urn(req_value or "")
+    matched = False
+    if (req_ns and rule_ns and req_ns == rule_ns) or (not req_ns and not rule_ns):
+        matched = req_entity is not None and bool(re.search(rule_regex, req_entity))
+    return matched, req_prefix != rule_prefix
+
+
 def check_hierarchical_scope(
     rule_target: Target,
     request: Request,
@@ -90,21 +108,13 @@ def check_hierarchical_scope(
                 ):
                     entities_match = True
                 elif request_attribute.id == attribute.id:
-                    # regex entity comparison with namespace verification
-                    rule_ns, entity_regex, rule_prefix = split_entity_urn(
-                        entity_or_operation
+                    set_flag, prefix_mismatch = regex_entity_compare(
+                        entity_or_operation, request_attribute.value
                     )
-                    req_value = request_attribute.value or ""
-                    req_ns, req_entity, req_prefix = split_entity_urn(req_value)
-                    if req_prefix != rule_prefix:
+                    if prefix_mismatch:
                         entities_match = False
-                    if (req_ns and rule_ns and req_ns == rule_ns) or (
-                        not req_ns and not rule_ns
-                    ):
-                        if req_entity is not None and re.search(
-                            entity_regex, req_entity
-                        ):
-                            entities_match = True
+                    if set_flag:
+                        entities_match = True
                 elif (
                     request_attribute.id == urns.get("resourceID")
                     and entities_match
@@ -193,10 +203,16 @@ def check_hierarchical_scope(
             context = access_controller.create_hr_scope(context)
             subject = _get(context, "subject") or {}
 
+        hierarchical_scopes = _get(subject, "hierarchical_scopes")
+        if hierarchical_scopes is None:
+            # the reference iterates an undefined list here and throws
+            # (hierarchicalScope.ts:209-220); surface the same failure as a
+            # typed error the service layer denies on
+            from .errors import InvalidRequestContext
+
+            raise InvalidRequestContext("subject.hierarchical_scopes missing")
         reduced_hr_scopes = [
-            h
-            for h in (_get(subject, "hierarchical_scopes") or [])
-            if _get(h, "role") == rule_role
+            h for h in hierarchical_scopes if _get(h, "role") == rule_role
         ]
         flat_org_list: list[str] = []
 

@@ -1,0 +1,16 @@
+"""TPU evaluator: string interner, policy compiler (tree -> tensors),
+request batch encoder and the jitted batched decision kernel."""
+
+from .interner import StringInterner
+from .compile import CompiledPolicies, compile_policies
+from .encode import RequestBatch, encode_requests
+from .kernel import DecisionKernel
+
+__all__ = [
+    "StringInterner",
+    "CompiledPolicies",
+    "compile_policies",
+    "RequestBatch",
+    "encode_requests",
+    "DecisionKernel",
+]

@@ -1,0 +1,352 @@
+"""Policy compiler: lowers the PolicySet -> Policy -> Rule tree into dense
+integer/bool tensors for the batched decision kernel.
+
+Layout: a padded ``[S, KP, KR]`` tree (sets x max-policies x max-rules) so
+the combining algorithms become masked reductions along static axes, plus a
+flat target table of ``T`` rows (set/policy/rule targets) whose match bits
+the kernel computes once per request and gathers per node.
+
+Everything order-dependent in the reference is resolved at compile time:
+
+- ``pol_eff_ctx``: the carried-over ``policyEffect`` visible when each
+  policy's target is matched (reference: src/core/accessController.ts:130,
+  138-148 — only ``policy.effect`` ever feeds it; the combining-algorithm
+  branch is dead code);
+- ``rule_cacheable_eff``: prefix-AND evaluation_cacheable semantics
+  (reference: :202-211, 277-282);
+- flat rule order for condition-abort priority (reference: :240-270 returns
+  on the first aborting rule in set->policy->rule iteration order).
+
+Trees outside the kernel's representable subset (attribute counts beyond
+the caps, targets mixing multiple entities with properties, missing
+combining algorithms on populated nodes) are flagged ``supported=False``
+and served entirely by the scalar oracle.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..models.model import PolicySet, Target
+from ..models.urns import Urns
+from .interner import ABSENT, StringInterner
+
+# attribute-count caps per target row (tensor padding widths)
+K_SUB = 6   # subject attribute pairs
+K_ACT = 3   # action attribute pairs
+K_ENT = 2   # entity attributes in resources
+K_OP = 2    # operation attributes in resources
+K_PROP = 12  # property attributes in resources
+
+CA_CODES = {
+    "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:deny-overrides": 0,
+    "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides": 1,
+    "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:first-applicable": 2,
+}
+
+EFFECT_CODES = {None: 0, "": 0, "PERMIT": 1, "DENY": 2}
+
+DECISION_NAMES = {0: "INDETERMINATE", 1: "PERMIT", 2: "DENY"}
+
+
+@dataclass
+class CompiledCondition:
+    """A host-assisted rule predicate: the condition source plus its
+    context query (pre-resolved per request before the kernel runs)."""
+
+    rule_flat_index: int
+    condition: str
+    context_query: Optional[object] = None
+
+
+@dataclass
+class CompiledPolicies:
+    interner: StringInterner
+    urns: Urns
+    arrays: dict[str, np.ndarray]
+    conditions: list[CompiledCondition]
+    entity_vocab: list[str]          # distinct target entity values (regex rows)
+    entity_vocab_ids: dict[int, int]  # interned value id -> vocab row
+    supported: bool = True
+    unsupported_reason: str = ""
+    S: int = 0
+    KP: int = 0
+    KR: int = 0
+    T: int = 0
+    version: int = 0
+
+    @property
+    def n_rules(self) -> int:
+        return int(self.arrays["rule_valid"].sum()) if self.S else 0
+
+    @property
+    def has_hr_targets(self) -> bool:
+        return bool(self.arrays["t_has_scoping"].any())
+
+
+def _pad(values: list[int], width: int) -> list[int]:
+    return (values + [ABSENT] * width)[:width]
+
+
+class _TargetTable:
+    def __init__(self, interner: StringInterner, urns: Urns):
+        self.interner = interner
+        self.urns = urns
+        self.rows: list[dict] = []
+        self.entity_vocab: list[str] = []
+        self.entity_vocab_ids: dict[int, int] = {}
+        self.unsupported: Optional[str] = None
+
+    def _vocab_row(self, value: str) -> int:
+        vid = self.interner.intern(value)
+        row = self.entity_vocab_ids.get(vid)
+        if row is None:
+            row = len(self.entity_vocab)
+            self.entity_vocab.append(value)
+            self.entity_vocab_ids[vid] = row
+        return row
+
+    def add(self, target: Optional[Target]) -> int:
+        """Lower a target into a row; returns the row index."""
+        urns = self.urns
+        it = self.interner.intern
+        row: dict = {}
+        t = target or Target()
+
+        role_urn = urns.get("role")
+        scoping_urn = urns.get("roleScopingEntity")
+        skip_acl_urn = urns.get("skipACL")
+        hr_urn = urns.get("hierarchicalRoleScoping")
+        entity_urn = urns.get("entity")
+        property_urn = urns.get("property")
+        operation_urn = urns.get("operation")
+
+        role = None
+        scoping = None
+        hr_check = "true"
+        skip_acl = False
+        sub_pairs = []
+        for a in t.subjects or []:
+            sub_pairs.append((it(a.id), it(a.value)))
+            if a.id == role_urn:
+                role = a.value
+            elif a.id == hr_urn:
+                hr_check = a.value
+            elif a.id == scoping_urn:
+                scoping = a.value
+            if a.id == skip_acl_urn:
+                skip_acl = True
+
+        act_pairs = [(it(a.id), it(a.value)) for a in (t.actions or [])]
+
+        ent_vals, op_vals, prop_vals = [], [], []
+        for a in t.resources or []:
+            if a.id == entity_urn:
+                ent_vals.append(a.value)
+            elif a.id == operation_urn:
+                op_vals.append(a.value)
+            elif a.id == property_urn:
+                prop_vals.append(a.value)
+            # other resource attribute ids never match anything in the
+            # reference matcher; they only affect nothing (ref :492-576)
+
+        if len(sub_pairs) > K_SUB or len(act_pairs) > K_ACT:
+            self.unsupported = "subject/action attribute count exceeds caps"
+        if len(ent_vals) > K_ENT or len(op_vals) > K_OP or len(prop_vals) > K_PROP:
+            self.unsupported = "resource attribute count exceeds caps"
+        for v in ent_vals:
+            try:
+                re.compile(v[v.rfind(":") + 1:].split(".")[-1])
+            except re.error:
+                self.unsupported = f"invalid regex in entity value {v!r}"
+        if len(ent_vals) > 1 and prop_vals:
+            # requestEntityURN ambiguity: multiple entities + properties mix
+            # per-attribute state the closed form cannot represent
+            self.unsupported = "target mixes multiple entities with properties"
+
+        ent_ids = [it(v) for v in ent_vals]
+        row["n_subjects"] = len(t.subjects or [])
+        row["role"] = it(role) if role is not None else ABSENT
+        row["has_role"] = role is not None
+        row["scoping"] = it(scoping) if scoping is not None else ABSENT
+        row["has_scoping"] = scoping is not None
+        row["hr_check"] = hr_check == "true"
+        row["skip_acl"] = skip_acl
+        row["sub_ids"] = _pad([p[0] for p in sub_pairs], K_SUB)
+        row["sub_vals"] = _pad([p[1] for p in sub_pairs], K_SUB)
+        row["act_ids"] = _pad([p[0] for p in act_pairs], K_ACT)
+        row["act_vals"] = _pad([p[1] for p in act_pairs], K_ACT)
+        row["ent_vals"] = _pad(ent_ids, K_ENT)
+        row["ent_w"] = _pad([self._vocab_row(v) for v in ent_vals], K_ENT)
+        row["ent_tails"] = _pad([self.interner.tail_id[i] for i in ent_ids], K_ENT)
+        row["op_vals"] = _pad([it(v) for v in op_vals], K_OP)
+        prop_ids = [it(v) for v in prop_vals]
+        row["prop_vals"] = _pad(prop_ids, K_PROP)
+        row["prop_sfx"] = _pad([self.interner.suffix_id[i] for i in prop_ids], K_PROP)
+        row["has_props"] = len(prop_vals) > 0
+        row["n_res"] = len(t.resources or [])
+        self.rows.append(row)
+        return len(self.rows) - 1
+
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        def col(name, dtype=np.int32):
+            return np.array([r[name] for r in self.rows], dtype=dtype)
+
+        return {
+            "t_n_subjects": col("n_subjects"),
+            "t_role": col("role"),
+            "t_has_role": col("has_role", bool),
+            "t_scoping": col("scoping"),
+            "t_has_scoping": col("has_scoping", bool),
+            "t_hr_check": col("hr_check", bool),
+            "t_skip_acl": col("skip_acl", bool),
+            "t_sub_ids": col("sub_ids"),
+            "t_sub_vals": col("sub_vals"),
+            "t_act_ids": col("act_ids"),
+            "t_act_vals": col("act_vals"),
+            "t_ent_vals": col("ent_vals"),
+            "t_ent_w": col("ent_w"),
+            "t_ent_tails": col("ent_tails"),
+            "t_op_vals": col("op_vals"),
+            "t_prop_vals": col("prop_vals"),
+            "t_prop_sfx": col("prop_sfx"),
+            "t_has_props": col("has_props", bool),
+            "t_n_res": col("n_res"),
+        }
+
+
+def compile_policies(
+    policy_sets: dict[str, Optional[PolicySet]] | list[PolicySet],
+    urns: Urns | None = None,
+    version: int = 0,
+) -> CompiledPolicies:
+    urns = urns or Urns()
+    interner = StringInterner()
+    table = _TargetTable(interner, urns)
+
+    if isinstance(policy_sets, dict):
+        sets = [ps for ps in policy_sets.values() if ps is not None]
+    else:
+        sets = [ps for ps in policy_sets if ps is not None]
+
+    S = max(len(sets), 1)
+    KP = max((len(ps.combinables) for ps in sets), default=0) or 1
+    KR = 1
+    for ps in sets:
+        for pol in ps.combinables.values():
+            if pol is not None:
+                KR = max(KR, len(pol.combinables))
+
+    unsupported: Optional[str] = None
+    conditions: list[CompiledCondition] = []
+
+    def zeros(dtype=np.int32, shape=None):
+        return np.full(shape, ABSENT if dtype == np.int32 else False, dtype=dtype)
+
+    a = {
+        "set_valid": zeros(bool, (S,)),
+        "set_ca": zeros(np.int32, (S,)),
+        "set_has_target": zeros(bool, (S,)),
+        "set_target": np.zeros((S,), np.int32),
+        "pol_valid": zeros(bool, (S, KP)),
+        "pol_ca": zeros(np.int32, (S, KP)),
+        "pol_effect": np.zeros((S, KP), np.int32),
+        "pol_cacheable": zeros(bool, (S, KP)),
+        "pol_has_target": zeros(bool, (S, KP)),
+        "pol_target": np.zeros((S, KP), np.int32),
+        "pol_has_subjects": zeros(bool, (S, KP)),
+        "pol_n_rules": np.zeros((S, KP), np.int32),
+        "pol_eff_ctx": np.zeros((S, KP), np.int32),
+        "pol_has_props": zeros(bool, (S, KP)),
+        "pol_ent_vals": np.full((S, KP, K_ENT), ABSENT, np.int32),
+        "rule_valid": zeros(bool, (S, KP, KR)),
+        "rule_effect": np.zeros((S, KP, KR), np.int32),
+        "rule_cacheable_raw": zeros(bool, (S, KP, KR)),
+        "rule_cacheable_eff": zeros(bool, (S, KP, KR)),
+        "rule_has_target": zeros(bool, (S, KP, KR)),
+        "rule_target": np.zeros((S, KP, KR), np.int32),
+        "rule_cond": np.full((S, KP, KR), ABSENT, np.int32),
+    }
+
+    for s, ps in enumerate(sets):
+        a["set_valid"][s] = True
+        ca = CA_CODES.get(ps.combining_algorithm, ABSENT)
+        a["set_ca"][s] = ca
+        if ps.target is not None:
+            a["set_has_target"][s] = True
+            a["set_target"][s] = table.add(ps.target)
+        policies = list(ps.combinables.values())
+        if ca == ABSENT and any(p is not None for p in policies):
+            unsupported = f"unknown combining algorithm on set {ps.id!r}"
+        eff_ctx = 0  # carried-over policyEffect, per set
+        for kp, pol in enumerate(policies):
+            if pol is None:
+                continue
+            a["pol_valid"][s, kp] = True
+            if pol.effect:
+                eff_ctx = EFFECT_CODES.get(pol.effect, 0)
+            a["pol_eff_ctx"][s, kp] = eff_ctx
+            a["pol_ca"][s, kp] = CA_CODES.get(pol.combining_algorithm, ABSENT)
+            a["pol_effect"][s, kp] = EFFECT_CODES.get(pol.effect, 0)
+            a["pol_cacheable"][s, kp] = bool(pol.evaluation_cacheable)
+            if pol.target is not None:
+                a["pol_has_target"][s, kp] = True
+                a["pol_target"][s, kp] = table.add(pol.target)
+                a["pol_has_subjects"][s, kp] = bool(pol.target.subjects)
+                a["pol_has_props"][s, kp] = table.rows[-1]["has_props"]
+                a["pol_ent_vals"][s, kp] = table.rows[-1]["ent_vals"]
+            rules = list(pol.combinables.values())
+            a["pol_n_rules"][s, kp] = len(rules)
+            if a["pol_ca"][s, kp] == ABSENT and any(r is not None for r in rules):
+                unsupported = f"unknown combining algorithm on policy {pol.id!r}"
+            cache_prefix = True
+            for kr, rule in enumerate(rules):
+                if rule is None:
+                    continue
+                a["rule_valid"][s, kp, kr] = True
+                a["rule_effect"][s, kp, kr] = EFFECT_CODES.get(rule.effect, 0)
+                raw = bool(rule.evaluation_cacheable)
+                a["rule_cacheable_raw"][s, kp, kr] = raw
+                cache_prefix = cache_prefix and raw
+                a["rule_cacheable_eff"][s, kp, kr] = raw and cache_prefix
+                if rule.target is not None:
+                    a["rule_has_target"][s, kp, kr] = True
+                    a["rule_target"][s, kp, kr] = table.add(rule.target)
+                if rule.condition:
+                    a["rule_cond"][s, kp, kr] = len(conditions)
+                    conditions.append(
+                        CompiledCondition(
+                            rule_flat_index=(s * KP + kp) * KR + kr,
+                            condition=rule.condition,
+                            context_query=rule.context_query,
+                        )
+                    )
+
+    if not table.rows:
+        table.add(None)
+    if table.unsupported:
+        unsupported = table.unsupported
+
+    arrays = dict(a)
+    arrays.update(table.to_arrays())
+
+    compiled = CompiledPolicies(
+        interner=interner,
+        urns=urns,
+        arrays=arrays,
+        conditions=conditions,
+        entity_vocab=table.entity_vocab,
+        entity_vocab_ids=table.entity_vocab_ids,
+        supported=unsupported is None,
+        unsupported_reason=unsupported or "",
+        S=S,
+        KP=KP,
+        KR=KR,
+        T=len(table.rows),
+        version=version,
+    )
+    return compiled

@@ -1,0 +1,455 @@
+"""Request batch encoder: wire-shaped requests -> dense integer tensors.
+
+Per-request Python cost is kept to attribute parsing + dict lookups; all
+string work (interning, regex evaluation, substring-relevance verification)
+is cached per *distinct* string across the batch.
+
+A request is **kernel-eligible** only when its shape fits the closed-form
+matcher the kernel implements; ineligible requests are flagged and served
+by the scalar oracle instead (decisions stay bit-identical either way).
+Ineligibility triggers:
+
+- a subject token (identity resolution / HR-scope rendezvous is a host
+  protocol, reference: src/core/accessController.ts:110-123);
+- context resources carrying ACLs (verifyACL not yet tensorized);
+- attribute counts beyond the padding caps;
+- malformed property URNs, properties preceding their entity, or
+  entity-name substring relevance diverging from id equality (the
+  reference matches properties to entities by substring, reference:
+  :515-516);
+- conditions with context queries when a resource adapter is configured
+  (the reference mutates request.context across rules in that path,
+  reference: :238-254).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..core.common import find_ctx_resource, get_field
+from ..core.conditions import condition_matches
+from ..core.hierarchical_scope import regex_entity_compare, split_entity_urn
+from ..models.model import Request
+from .compile import CompiledPolicies
+from .interner import ABSENT
+
+# per-request padding caps
+NR = 4      # entity runs
+NI = 4      # resource instances
+NP = 8      # property attributes
+NSUB = 8    # subject attribute pairs
+NACT = 4    # action attribute pairs
+NOP = 2     # operation attributes
+NOWN = 4    # owner pairs per instance
+NRA = 8     # role-association triples / pairs
+NHR = 32    # flattened HR-scope pairs
+NROLE = 4   # subject roles
+
+
+@dataclass
+class RequestBatch:
+    B: int
+    arrays: dict[str, np.ndarray]
+    # regex matrices over (target entity vocab W) x (batch entity values E)
+    rgx_set: np.ndarray
+    pfx_neq: np.ndarray
+    # host-assisted condition results [C, B]
+    cond_true: np.ndarray
+    cond_abort: np.ndarray
+    cond_code: np.ndarray
+    eligible: np.ndarray
+    requests: list[Request] = field(default_factory=list)
+
+
+class _RegexCache:
+    """(target entity value, request entity value) -> regex-branch results,
+    mirroring the reference comparison (reference: accessController.ts:526-566)."""
+
+    def __init__(self, entity_vocab: list[str]):
+        self.vocab = entity_vocab
+        self.cache: dict[str, tuple[list[bool], list[bool]]] = {}
+
+    def lookup(self, req_value: str) -> tuple[list[bool], list[bool]]:
+        hit = self.cache.get(req_value)
+        if hit is not None:
+            return hit
+        set_col, neq_col = [], []
+        for rule_val in self.vocab:
+            matched, prefix_mismatch = regex_entity_compare(rule_val, req_value)
+            set_col.append(matched)
+            neq_col.append(prefix_mismatch)
+        self.cache[req_value] = (set_col, neq_col)
+        return set_col, neq_col
+
+
+def _flatten_hr(scopes, out: list[tuple[Optional[str], str]]):
+    """(top-level role, node id) pairs for every node of each top-level
+    subtree (reference: hierarchicalScope.ts:207-220 filters by top role
+    then flattens the subtree)."""
+    for top in scopes or []:
+        role = get_field(top, "role")
+        stack = [top]
+        while stack:
+            node = stack.pop()
+            node_id = get_field(node, "id")
+            if node_id:
+                out.append((role, node_id))
+            stack.extend(get_field(node, "children") or [])
+
+
+def encode_requests(
+    requests: list[Request],
+    compiled: CompiledPolicies,
+    resource_adapter=None,
+) -> RequestBatch:
+    urns = compiled.urns
+    it = compiled.interner.intern
+    B = len(requests)
+    W = max(len(compiled.entity_vocab), 1)
+
+    entity_urn = urns.get("entity")
+    property_urn = urns.get("property")
+    operation_urn = urns.get("operation")
+    resource_id_urn = urns.get("resourceID")
+    role_urn = urns.get("role")
+    scoping_urn = urns.get("roleScopingEntity")
+    scoping_inst_urn = urns.get("roleScopingInstance")
+    owner_ent_urn = urns.get("ownerEntity")
+    owner_inst_urn = urns.get("ownerInstance")
+    action_id_urn = urns.get("actionID")
+    crud_actions = {
+        urns.get("create"), urns.get("read"),
+        urns.get("modify"), urns.get("delete"),
+    }
+
+    rgx = _RegexCache(compiled.entity_vocab)
+    batch_entity_values: list[str] = []
+    batch_entity_idx: dict[str, int] = {}
+    # substring-relevance verification cache: (vocab tail, prop value)
+    relevance_ok: dict[tuple[str, str], bool] = {}
+    vocab_tails = [split_entity_urn(v)[1] for v in compiled.entity_vocab]
+    # two distinct target entity values sharing a tail would make substring
+    # relevance ambiguous against id equality
+    tails_ambiguous = len(set(vocab_tails)) != len(vocab_tails)
+
+    def batch_entity(value: str) -> int:
+        idx = batch_entity_idx.get(value)
+        if idx is None:
+            idx = len(batch_entity_values)
+            batch_entity_idx[value] = idx
+            batch_entity_values.append(value)
+        return idx
+
+    a = {
+        "r_sub_ids": np.full((B, NSUB), ABSENT, np.int32),
+        "r_sub_vals": np.full((B, NSUB), ABSENT, np.int32),
+        "r_roles": np.full((B, NROLE), ABSENT, np.int32),
+        "r_act_ids": np.full((B, NACT), ABSENT, np.int32),
+        "r_act_vals": np.full((B, NACT), ABSENT, np.int32),
+        "r_ent_vals": np.full((B, NR), ABSENT, np.int32),
+        "r_ent_e": np.zeros((B, NR), np.int32),
+        "r_ent_valid": np.zeros((B, NR), bool),
+        "r_inst_run": np.full((B, NI), ABSENT, np.int32),
+        "r_inst_valid": np.zeros((B, NI), bool),
+        "r_inst_present": np.zeros((B, NI), bool),
+        "r_inst_has_owners": np.zeros((B, NI), bool),
+        "r_inst_owner_ent": np.full((B, NI, NOWN), ABSENT, np.int32),
+        "r_inst_owner_inst": np.full((B, NI, NOWN), ABSENT, np.int32),
+        "r_prop_vals": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_sfx": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_run": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_tail": np.full((B, NP), ABSENT, np.int32),
+        "r_op_vals": np.full((B, NOP), ABSENT, np.int32),
+        "r_op_present": np.zeros((B, NOP), bool),
+        "r_op_has_owners": np.zeros((B, NOP), bool),
+        "r_op_owner_ent": np.full((B, NOP, NOWN), ABSENT, np.int32),
+        "r_op_owner_inst": np.full((B, NOP, NOWN), ABSENT, np.int32),
+        "r_ra3": np.full((B, NRA, 3), ABSENT, np.int32),
+        "r_ra2": np.full((B, NRA, 2), ABSENT, np.int32),
+        "r_n_ra": np.zeros((B,), np.int32),
+        "r_hr": np.full((B, NHR, 2), ABSENT, np.int32),
+        "r_ctx_present": np.zeros((B,), bool),
+        "r_n_entity_attrs": np.zeros((B,), np.int32),
+        "r_has_props": np.zeros((B,), bool),
+        "r_has_target": np.zeros((B,), bool),
+        # verify_acl no-ACL failure-path inputs (reference: verifyACL.ts):
+        # any resourceID/operation attribute triggers the early all-clear
+        # when ACL metadata is absent (:56-59); otherwise empty role
+        # associations fail (:96-100) and only CRUD actions pass (:148-248)
+        "r_has_idop": np.zeros((B,), bool),
+        "r_action_crud": np.zeros((B,), bool),
+    }
+    eligible = np.ones((B,), bool)
+
+    def mark(b, reason=None):
+        eligible[b] = False
+
+    for b, request in enumerate(requests):
+        target = request.target
+        if not target:
+            mark(b)  # no-target requests are a host-side 400 DENY
+            continue
+        a["r_has_target"][b] = True
+        context = request.context
+        subject = get_field(context, "subject") or {}
+        if get_field(subject, "token"):
+            mark(b)
+            continue
+
+        # ---- subject / roles / actions
+        subs = target.subjects or []
+        acts = target.actions or []
+        if len(subs) > NSUB or len(acts) > NACT:
+            mark(b)
+            continue
+        for j, attr in enumerate(subs):
+            a["r_sub_ids"][b, j] = it(attr.id)
+            a["r_sub_vals"][b, j] = it(attr.value)
+        for j, attr in enumerate(acts):
+            a["r_act_ids"][b, j] = it(attr.id)
+            a["r_act_vals"][b, j] = it(attr.value)
+
+        role_assocs = get_field(subject, "role_associations") or []
+        roles = []
+        for ra in role_assocs:
+            role = get_field(ra, "role")
+            if role is not None and role not in roles:
+                roles.append(role)
+        if len(roles) > NROLE:
+            mark(b)
+            continue
+        for j, role in enumerate(roles):
+            a["r_roles"][b, j] = it(role)
+
+        # ---- resources: parse (entity, id*, prop*) runs / operations
+        runs: list[dict] = []
+        props: list[tuple[str, Optional[dict]]] = []
+        ops: list[str] = []
+        current_run: Optional[dict] = None
+        ok = True
+        for attr in target.resources or []:
+            if attr.id == entity_urn:
+                current_run = {"value": attr.value, "instances": []}
+                runs.append(current_run)
+            elif attr.id == resource_id_urn:
+                if current_run is None:
+                    # ids before any entity are never collected by the
+                    # matcher/HR loops; ignore for the kernel
+                    continue
+                current_run["instances"].append(attr.value)
+            elif attr.id == property_urn:
+                # run index -1 when the property precedes any entity attr:
+                # the reference never checks it (entityMatch still false)
+                props.append((attr.value or "", len(runs) - 1))
+            elif attr.id == operation_urn:
+                ops.append(attr.value)
+            else:
+                ok = False  # unknown resource attribute id
+                break
+        if not ok or len(runs) > NR or len(props) > NP or len(ops) > NOP:
+            mark(b)
+            continue
+        if sum(len(r["instances"]) for r in runs) > NI:
+            mark(b)
+            continue
+        if tails_ambiguous and props:
+            mark(b)
+            continue
+        # verify substring relevance == tail equality for every
+        # (vocab entity, request property) pair
+        relevance_broken = False
+        for value, _run_idx in props:
+            for vt in vocab_tails:
+                key = (vt, value)
+                good = relevance_ok.get(key)
+                if good is None:
+                    prop_tail = split_entity_urn(value.split("#", 1)[0])[1]
+                    good = (vt in value) == (vt == prop_tail)
+                    relevance_ok[key] = good
+            # any pair breaking the equivalence disqualifies the request
+            if any(not relevance_ok[(vt, value)] for vt in vocab_tails):
+                relevance_broken = True
+                break
+        if relevance_broken:
+            mark(b)
+            continue
+
+        ctx_resources = get_field(context, "resources") or [] if context else []
+        # ACLs present anywhere -> oracle fallback (kernel v1)
+        has_acls = False
+        for res in ctx_resources:
+            meta = get_field(res, "meta")
+            if meta and (get_field(meta, "acls") or []):
+                has_acls = True
+                break
+        if has_acls:
+            mark(b)
+            continue
+
+        a["r_ctx_present"][b] = bool(context)
+        a["r_n_entity_attrs"][b] = len(runs)
+        a["r_has_props"][b] = len(props) > 0
+        a["r_has_idop"][b] = len(ops) > 0 or any(
+            attr.id == resource_id_urn for attr in (target.resources or [])
+        )
+        first_action = acts[0] if acts else None
+        a["r_action_crud"][b] = (
+            first_action is not None
+            and first_action.id == action_id_urn
+            and first_action.value in crud_actions
+        )
+
+        inst_slot = 0
+        overflow = False
+        for j, run in enumerate(runs):
+            a["r_ent_vals"][b, j] = it(run["value"])
+            a["r_ent_e"][b, j] = batch_entity(run["value"])
+            a["r_ent_valid"][b, j] = True
+            for inst in run["instances"]:
+                ctx_res = find_ctx_resource(ctx_resources, inst)
+                a["r_inst_run"][b, inst_slot] = j
+                a["r_inst_valid"][b, inst_slot] = True
+                if ctx_res is not None:
+                    a["r_inst_present"][b, inst_slot] = True
+                    owners = get_field(get_field(ctx_res, "meta"), "owners") or []
+                    a["r_inst_has_owners"][b, inst_slot] = len(owners) > 0
+                    if not _encode_owners(
+                        a["r_inst_owner_ent"], a["r_inst_owner_inst"],
+                        (b, inst_slot), owners, owner_ent_urn, owner_inst_urn, it,
+                    ):
+                        overflow = True
+                inst_slot += 1
+        for j, (value, run_idx) in enumerate(props):
+            vid = it(value)
+            a["r_prop_vals"][b, j] = vid
+            a["r_prop_sfx"][b, j] = compiled.interner.suffix_id[vid]
+            a["r_prop_run"][b, j] = run_idx
+            prefix = value.split("#", 1)[0]
+            a["r_prop_tail"][b, j] = it(split_entity_urn(prefix)[1])
+        for j, op_value in enumerate(ops):
+            a["r_op_vals"][b, j] = it(op_value)
+            ctx_res = None
+            for res in ctx_resources:
+                if get_field(res, "id") == op_value:
+                    ctx_res = res
+                    break
+            if ctx_res is not None:
+                a["r_op_present"][b, j] = True
+                owners = get_field(get_field(ctx_res, "meta"), "owners") or []
+                a["r_op_has_owners"][b, j] = len(owners) > 0
+                if not _encode_owners(
+                    a["r_op_owner_ent"], a["r_op_owner_inst"],
+                    (b, j), owners, owner_ent_urn, owner_inst_urn, it,
+                ):
+                    overflow = True
+
+        # ---- role-association triples / pairs + HR closure
+        ra3, ra2 = [], []
+        for ra in role_assocs:
+            role_id = it(get_field(ra, "role"))
+            for ra_attr in get_field(ra, "attributes") or []:
+                if get_field(ra_attr, "id") != scoping_urn:
+                    continue
+                ent_id = it(get_field(ra_attr, "value"))
+                pair = (role_id, ent_id)
+                if pair not in ra2:
+                    ra2.append(pair)
+                for inst in get_field(ra_attr, "attributes") or []:
+                    if get_field(inst, "id") == scoping_inst_urn:
+                        ra3.append((role_id, ent_id, it(get_field(inst, "value"))))
+        hierarchical_scopes = get_field(subject, "hierarchical_scopes")
+        if hierarchical_scopes is None and len(role_assocs) > 0:
+            # with role associations present the oracle raises
+            # InvalidRequestContext for a missing scope list (the reference
+            # throws in both verifyACL and the HR phase); keep such
+            # requests on the oracle path
+            mark(b)
+            continue
+        hr_pairs: list[tuple[Optional[str], str]] = []
+        _flatten_hr(hierarchical_scopes, hr_pairs)
+        hr_enc = []
+        for role, org in hr_pairs:
+            entry = (it(role) if role is not None else ABSENT, it(org))
+            if entry not in hr_enc:
+                hr_enc.append(entry)
+        if len(ra3) > NRA or len(ra2) > NRA or len(hr_enc) > NHR or overflow:
+            mark(b)
+            continue
+        for j, t3 in enumerate(ra3):
+            a["r_ra3"][b, j] = t3
+        for j, t2 in enumerate(ra2):
+            a["r_ra2"][b, j] = t2
+        for j, t2 in enumerate(hr_enc):
+            a["r_hr"][b, j] = t2
+        a["r_n_ra"][b] = len(role_assocs)
+
+    # ---- regex matrices [W, E]
+    E = max(len(batch_entity_values), 1)
+    rgx_set = np.zeros((W, E), bool)
+    pfx_neq = np.zeros((W, E), bool)
+    for e, value in enumerate(batch_entity_values):
+        set_col, neq_col = rgx.lookup(value)
+        if set_col:
+            rgx_set[:, e] = set_col
+            pfx_neq[:, e] = neq_col
+
+    # ---- host-assisted condition pre-pass [C, B]
+    C = len(compiled.conditions)
+    cond_true = np.zeros((C, B), bool)
+    cond_abort = np.zeros((C, B), bool)
+    cond_code = np.full((C, B), 200, np.int32)
+    for ci, cc in enumerate(compiled.conditions):
+        has_query = cc.context_query is not None and (
+            getattr(cc.context_query, "filters", None)
+            or getattr(cc.context_query, "query", None)
+        )
+        if has_query and resource_adapter is not None:
+            # adapter-driven context queries mutate request.context across
+            # rules; keep those on the oracle path
+            eligible[:] = False
+            break
+        for b, request in enumerate(requests):
+            if not eligible[b]:
+                continue
+            try:
+                cond_true[ci, b] = bool(condition_matches(cc.condition, request))
+            except Exception as err:  # deny-by-default with the error code
+                code = getattr(err, "code", 500)
+                cond_abort[ci, b] = True
+                cond_code[ci, b] = code if isinstance(code, int) else 500
+
+    return RequestBatch(
+        B=B,
+        arrays=a,
+        rgx_set=rgx_set,
+        pfx_neq=pfx_neq,
+        cond_true=cond_true,
+        cond_abort=cond_abort,
+        cond_code=cond_code,
+        eligible=eligible,
+        requests=requests,
+    )
+
+
+def _encode_owners(
+    ent_out, inst_out, index, owners, owner_ent_urn, owner_inst_urn, it
+) -> bool:
+    """Flatten owner entries into (owner-entity-value, owner-instance)
+    pairs; only well-formed entries participate in matching."""
+    slot = 0
+    for owner in owners:
+        if get_field(owner, "id") != owner_ent_urn:
+            continue
+        val = it(get_field(owner, "value"))
+        for inst_attr in get_field(owner, "attributes") or []:
+            if get_field(inst_attr, "id") == owner_inst_urn:
+                if slot >= NOWN:
+                    return False
+                ent_out[index + (slot,)] = val
+                inst_out[index + (slot,)] = it(get_field(inst_attr, "value"))
+                slot += 1
+    return True

@@ -1,0 +1,494 @@
+"""The batched decision kernel: one vmapped, jitted function computing
+isAllowed decisions for a request batch against the compiled policy tensors.
+
+Everything the reference evaluates with nested loops and mutable flags
+(reference: src/core/accessController.ts:88-324) is expressed here as masked
+boolean algebra over padded tensors:
+
+- target matching over the flat target table ``[T]`` in PERMIT/DENY effect
+  variants (the property gates are effect-asymmetric, reference: :578-588,
+  644-647), exact and regex modes (regex results come from host-computed
+  ``[W, E]`` matrices);
+- positional property relevance via cumulative/sticky entity-match state
+  per entity run (reference: :501-525 state machine);
+- hierarchical-scope checks per target row (direct owner match + flattened
+  HR-closure membership, sticky collection scan, reference:
+  hierarchicalScope.ts:54-258);
+- combining algorithms as masked position reductions along the rule/policy
+  axes (first-DENY / first-PERMIT / first / last);
+- the exact-match break index and its carried ``policyEffect`` (reference:
+  :136-157), the multi-entity recheck (:429-463), condition aborts in flat
+  rule order (:240-270) and last-set-wins decision assembly (:293-295).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compile import CompiledPolicies
+from .encode import RequestBatch
+from .interner import ABSENT
+
+BIG = jnp.int32(1 << 30)
+
+
+def _pairs_subset(rule_ids, rule_vals, req_ids, req_vals):
+    """Every valid rule (id, value) pair appears among the request pairs
+    (reference: attributesMatch, accessController.ts:681-699)."""
+    rule_valid = rule_ids >= 0
+    # [K_rule, K_req] equality
+    eq = (rule_ids[:, None] == req_ids[None, :]) & (
+        rule_vals[:, None] == req_vals[None, :]
+    ) & (req_ids[None, :] >= 0)
+    return jnp.all(~rule_valid | eq.any(axis=1))
+
+
+def _member(needle, haystack):
+    """needle in haystack (1-D), ignoring ABSENT padding."""
+    return jnp.any((haystack == needle) & (haystack >= 0))
+
+
+def _evaluate_one(c: dict, r: dict):
+    """Decision for a single encoded request; vmapped over the batch.
+
+    ``c``: compiled policy arrays (closed over, replicated across devices).
+    ``r``: per-request encoded arrays.
+    Returns (decision, cacheable, status_code) int32 scalars where
+    decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
+    """
+    T = c["t_role"].shape[0]
+
+    # ---------------------------------------------------------------- A: targets
+    # subject matching (reference: checkSubjectMatches :793-823)
+    sub_pairs_ok = jax.vmap(
+        lambda ids, vals: _pairs_subset(ids, vals, r["r_sub_ids"], r["r_sub_vals"])
+    )(c["t_sub_ids"], c["t_sub_vals"])
+    role_ok = jax.vmap(lambda role: _member(role, r["r_roles"]))(c["t_role"])
+    sub_ok = (c["t_n_subjects"] == 0) | jnp.where(
+        c["t_has_role"], role_ok, sub_pairs_ok
+    )
+
+    act_ok = jax.vmap(
+        lambda ids, vals: _pairs_subset(ids, vals, r["r_act_ids"], r["r_act_vals"])
+    )(c["t_act_ids"], c["t_act_vals"])
+
+    # entity matches per (target, run): exact and regex
+    ent_valid = r["r_ent_valid"]  # [NR]
+    em_ex = (
+        (c["t_ent_vals"][:, :, None] == r["r_ent_vals"][None, None, :])
+        & (c["t_ent_vals"][:, :, None] >= 0)
+        & ent_valid[None, None, :]
+    ).any(axis=1)  # [T, NR]
+    w_idx = jnp.clip(c["t_ent_w"], 0, None)  # [T, K_ENT]
+    e_idx = jnp.clip(r["r_ent_e"], 0, None)  # [NR]
+    rgx_hit = r["rgx_set"][w_idx[:, :, None], e_idx[None, None, :]]  # [T,K,NR]
+    rgx_hit = rgx_hit & (c["t_ent_w"][:, :, None] >= 0) & ent_valid[None, None, :]
+    em_rg = rgx_hit.any(axis=1)  # [T, NR]
+    pfx_neq = r["pfx_neq"][w_idx[:, :, None], e_idx[None, None, :]]
+    pfx_neq = pfx_neq & (c["t_ent_w"][:, :, None] >= 0) & ent_valid[None, None, :]
+
+    ent_any_ex = em_ex.any(axis=1)  # [T]
+    ent_any_rg = em_rg.any(axis=1)
+
+    # operation match (exact mode only; the regex branch has no operation
+    # comparison, reference: :526-574)
+    opm = (
+        (c["t_op_vals"][:, :, None] == r["r_op_vals"][None, None, :])
+        & (c["t_op_vals"][:, :, None] >= 0)
+        & (r["r_op_vals"][None, None, :] >= 0)
+    ).any(axis=(1, 2))  # [T]
+
+    # positional entity-match state per run:
+    # exact mode: cumulative OR (never resets, reference: :501-505)
+    state_ex = jnp.cumsum(em_ex.astype(jnp.int32), axis=1) > 0  # [T, NR]
+    # regex mode: sticky with prefix-mismatch reset (reference: :526-566)
+    def _sticky(carry, inputs):
+        set_bit, reset_bit = inputs
+        state = jnp.where(set_bit, True, jnp.where(reset_bit, False, carry))
+        return state, state
+
+    # per run j: set if regex matched, else reset if prefix mismatched
+    reset_rg = pfx_neq.any(axis=1) & ~em_rg  # [T, NR]
+    _, state_rg_t = jax.lax.scan(
+        _sticky,
+        jnp.zeros((T,), bool),
+        (em_rg.T, reset_rg.T),
+    )
+    state_rg = state_rg_t.T  # [T, NR]
+
+    # property gates
+    prop_valid = r["r_prop_vals"] >= 0  # [NP]
+    prop_run = jnp.clip(r["r_prop_run"], 0, None)  # [NP]
+    prop_has_run = r["r_prop_run"] >= 0
+    # relevance (exact): entity matched at-or-before the prop's run AND the
+    # target entity tail equals the prop's prefix tail (verified by the
+    # encoder to coincide with the reference substring check)
+    state_at_prop_ex = jnp.take(state_ex, prop_run, axis=1) & prop_has_run[None, :]
+    tail_eq = (
+        (c["t_ent_tails"][:, :, None] == r["r_prop_tail"][None, None, :])
+        & (c["t_ent_tails"][:, :, None] >= 0)
+    ).any(axis=1)  # [T, NP]
+    relevant_ex = prop_valid[None, :] & state_at_prop_ex & tail_eq
+    in_rule = (
+        (c["t_prop_vals"][:, :, None] == r["r_prop_vals"][None, None, :])
+        & (c["t_prop_vals"][:, :, None] >= 0)
+    ).any(axis=1)  # [T, NP]
+    sfx_in_rule = (
+        (c["t_prop_sfx"][:, :, None] == r["r_prop_sfx"][None, None, :])
+        & (c["t_prop_sfx"][:, :, None] >= 0)
+    ).any(axis=1)  # [T, NP]
+    state_at_prop_rg = jnp.take(state_rg, prop_run, axis=1) & prop_has_run[None, :]
+    relevant_rg = prop_valid[None, :] & state_at_prop_rg
+
+    has_props = c["t_has_props"]
+    r_has_props = r["r_has_props"]
+    # regex-mode entity state: "true at any point" feeds the per-attribute
+    # PERMIT fail check; the *final* state feeds the end-of-loop entity gate
+    # (a later prefix mismatch can reset it, reference: :545-566, 650-653);
+    # exact-mode state is monotone so any == final
+    state_any_rg = state_rg.any(axis=1)
+    NRr = state_rg.shape[1]
+    state_final_rg = state_rg[:, NRr - 1]
+    permit_fail_ex = has_props & (
+        (~r_has_props & ent_any_ex) | (relevant_ex & ~in_rule).any(axis=1)
+    )
+    deny_skip_ex = has_props & r_has_props & ~(relevant_ex & in_rule).any(axis=1)
+    permit_fail_rg = has_props & (
+        (~r_has_props & state_any_rg) | (relevant_rg & ~sfx_in_rule).any(axis=1)
+    )
+    deny_skip_rg = has_props & r_has_props & ~(relevant_rg & sfx_in_rule).any(axis=1)
+
+    no_res = c["t_n_res"] == 0
+    res_ex_p = no_res | ((ent_any_ex | opm) & ~permit_fail_ex)
+    res_ex_d = no_res | ((ent_any_ex | opm) & ~deny_skip_ex)
+    res_rg_p = no_res | (state_final_rg & ~permit_fail_rg)
+    res_rg_d = no_res | (state_final_rg & ~deny_skip_rg)
+
+    base = sub_ok & act_ok
+    tm_ex_p = base & res_ex_p
+    tm_ex_d = base & res_ex_d
+    tm_rg_p = base & res_rg_p
+    tm_rg_d = base & res_rg_d
+
+    # ------------------------------------------------------------- B: HR scopes
+    # collection per (target, entity slot, run) with sticky state like the
+    # reference HR loop (exact OR regex sets, prefix mismatch resets,
+    # reference: hierarchicalScope.ts:61-124)
+    em_ex_k = (
+        (c["t_ent_vals"][:, :, None] == r["r_ent_vals"][None, None, :])
+        & (c["t_ent_vals"][:, :, None] >= 0)
+        & ent_valid[None, None, :]
+    )  # [T, K_ENT, NR]
+    set_k = em_ex_k | rgx_hit  # regex set wins over reset
+    reset_k = pfx_neq & ~set_k
+
+    def _sticky_k(carry, inputs):
+        set_bit, reset_bit = inputs
+        state = jnp.where(set_bit, True, jnp.where(reset_bit, False, carry))
+        return state, state
+
+    _, coll_t = jax.lax.scan(
+        _sticky_k,
+        jnp.zeros(set_k.shape[:2], bool),
+        (jnp.moveaxis(set_k, 2, 0), jnp.moveaxis(reset_k, 2, 0)),
+    )
+    collect = jnp.moveaxis(coll_t, 0, 2).any(axis=1)  # [T, NR]
+
+    inst_valid = r["r_inst_valid"]  # [NI]
+    inst_run = jnp.clip(r["r_inst_run"], 0, None)
+    need_inst = jnp.take(collect, inst_run, axis=1) & inst_valid[None, :] & (
+        r["r_inst_run"] >= 0
+    )[None, :]  # [T, NI]
+    inst_missing = need_inst & (
+        ~r["r_inst_present"] | ~r["r_inst_has_owners"]
+    )[None, :]
+
+    # owner pair checks against role associations / HR closure
+    def owner_checks(owner_ent, owner_inst):
+        # owner_ent/owner_inst: [N, NOWN]; returns direct/hier [T, N]
+        o_valid = owner_ent >= 0
+        ent_match = (
+            c["t_scoping"][:, None, None] == owner_ent[None, :, :]
+        ) & o_valid[None, :, :]  # [T, N, NOWN]
+        # direct: (role, scoping, owner-instance) in ra3
+        ra3 = r["r_ra3"]  # [NRA, 3]
+        ra3_valid = ra3[:, 1] >= 0
+        direct_pair = (
+            (c["t_role"][:, None, None, None] == ra3[None, None, None, :, 0])
+            & (c["t_scoping"][:, None, None, None] == ra3[None, None, None, :, 1])
+            & (owner_inst[None, :, :, None] == ra3[None, None, None, :, 2])
+            & ra3_valid[None, None, None, :]
+        ).any(axis=3)  # [T, N, NOWN]
+        direct = (ent_match & direct_pair).any(axis=2)  # [T, N]
+        # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
+        ra2 = r["r_ra2"]
+        ra2_valid = ra2[:, 1] >= 0
+        ra2_ok = (
+            (c["t_role"][:, None] == ra2[None, :, 0])
+            & (c["t_scoping"][:, None] == ra2[None, :, 1])
+            & ra2_valid[None, :]
+        ).any(axis=1)  # [T]
+        hr = r["r_hr"]
+        hr_valid = hr[:, 1] >= 0
+        hr_pair = (
+            (c["t_role"][:, None, None, None] == hr[None, None, None, :, 0])
+            & (owner_inst[None, :, :, None] == hr[None, None, None, :, 1])
+            & hr_valid[None, None, None, :]
+        ).any(axis=3)  # [T, N, NOWN]
+        hier = (ent_match & hr_pair).any(axis=2) & ra2_ok[:, None]
+        return direct, hier
+
+    inst_direct, inst_hier = owner_checks(
+        r["r_inst_owner_ent"], r["r_inst_owner_inst"]
+    )
+    inst_ok = inst_direct | (c["t_hr_check"][:, None] & inst_hier)
+    inst_bad = need_inst & ~inst_ok
+
+    # operation-resource branch (reference: hierarchicalScope.ts:126-147)
+    op_hit = (
+        (c["t_op_vals"][:, :, None] == r["r_op_vals"][None, None, :])
+        & (c["t_op_vals"][:, :, None] >= 0)
+        & (r["r_op_vals"][None, None, :] >= 0)
+    ).any(axis=1)  # [T, NOP]
+    op_missing = op_hit & (~r["r_op_present"] | ~r["r_op_has_owners"])[None, :]
+    op_direct, op_hier = owner_checks(r["r_op_owner_ent"], r["r_op_owner_inst"])
+    op_ok = op_direct | (c["t_hr_check"][:, None] & op_hier)
+    op_bad = op_hit & ~op_ok
+
+    hr_trivial = (c["t_n_subjects"] == 0) | ~c["t_has_scoping"]
+    hr_pass = hr_trivial | (
+        r["r_ctx_present"]
+        & (r["r_n_ra"] > 0)
+        & ~inst_missing.any(axis=1)
+        & ~inst_bad.any(axis=1)
+        & ~op_missing.any(axis=1)
+        & ~op_bad.any(axis=1)
+    )
+
+    # -------------------------------------------------------------- C: rules
+    def gather_t(table, idx):
+        return jnp.take(table, idx, axis=0)
+
+    rt = c["rule_target"]  # [S, KP, KR]
+    rule_deny = c["rule_effect"] == 2
+    tm_rule_ex = jnp.where(rule_deny, gather_t(tm_ex_d, rt), gather_t(tm_ex_p, rt))
+    tm_rule_rg = jnp.where(rule_deny, gather_t(tm_rg_d, rt), gather_t(tm_rg_p, rt))
+    tm_rule = ~c["rule_has_target"] | tm_rule_ex | tm_rule_rg
+    hr_rule = ~c["rule_has_target"] | gather_t(hr_pass, rt)
+    reached = c["rule_valid"] & tm_rule & hr_rule
+
+    # verify_acl no-ACL semantics (eligible requests carry no ACL
+    # metadata): skipACL passes; any resourceID/operation attribute hits
+    # the early all-clear; otherwise role associations must exist and the
+    # first action must be a CRUD action (reference: verifyACL.ts:21-24,
+    # 56-59, 96-100, 148-250)
+    acl_ok_t = gather_t(c["t_skip_acl"], rt) | r["r_has_idop"] | (
+        (r["r_n_ra"] > 0) & r["r_action_crud"]
+    )
+    acl_rule = ~c["rule_has_target"] | acl_ok_t
+
+    has_cond = c["rule_cond"] >= 0
+    cond_idx = jnp.clip(c["rule_cond"], 0, None)
+    if r["cond_true"].shape[0] > 0:
+        cond_t = jnp.take(r["cond_true"], cond_idx)
+        cond_a = jnp.take(r["cond_abort"], cond_idx)
+        cond_c = jnp.take(r["cond_code"], cond_idx)
+    else:
+        cond_t = jnp.ones_like(cond_idx, dtype=bool)
+        cond_a = jnp.zeros_like(cond_idx, dtype=bool)
+        cond_c = jnp.full_like(cond_idx, 200)
+
+    # --------------------------------------- D: set-level exact match + gates
+    # first loop: per-policy carried effect (compile-time pol_eff_ctx)
+    pt = c["pol_target"]
+    ctx_deny = c["pol_eff_ctx"] == 2
+    pol_tm_first = jnp.where(ctx_deny, gather_t(tm_ex_d, pt), gather_t(tm_ex_p, pt))
+    pol_tm_first = pol_tm_first & c["pol_valid"] & c["pol_has_target"]  # [S, KP]
+    KP = pol_tm_first.shape[1]
+    kp_pos = jnp.arange(KP)
+    first_kp = jnp.min(
+        jnp.where(pol_tm_first, kp_pos[None, :], BIG), axis=1
+    )  # [S]
+    exact0 = pol_tm_first.any(axis=1)
+    last_valid_kp = jnp.max(
+        jnp.where(c["pol_valid"], kp_pos[None, :], -1), axis=1
+    )
+    eff_src_kp = jnp.where(exact0, jnp.clip(first_kp, 0, KP - 1),
+                           jnp.clip(last_valid_kp, 0, KP - 1))
+    eval_eff = jnp.take_along_axis(
+        c["pol_eff_ctx"], eff_src_kp[:, None], axis=1
+    )[:, 0]  # [S] carried policyEffect after the break (reference: :130-157)
+
+    # multi-entity recheck (reference: :429-463): every requested entity must
+    # exactly match some policy's resources; PERMIT policies with properties
+    # never match a bare entity attribute
+    pol_ent_hit = (
+        (c["pol_ent_vals"][:, :, :, None] == r["r_ent_vals"][None, None, None, :])
+        & (c["pol_ent_vals"][:, :, :, None] >= 0)
+        & ent_valid[None, None, None, :]
+    ).any(axis=2)  # [S, KP, NR]
+    pol_multi_ok = pol_ent_hit & ~(
+        (c["pol_effect"] == 1) & c["pol_has_props"]
+    )[:, :, None] & c["pol_valid"][:, :, None]
+    multi_ok = jnp.all(~ent_valid[None, :] | pol_multi_ok.any(axis=1), axis=1)  # [S]
+    exact = exact0 & jnp.where(r["r_n_entity_attrs"] > 1, multi_ok, True)
+
+    # second loop: policy gate with the frozen carried effect
+    eval_deny = (eval_eff == 2)[:, None]
+    pol_tm_ex = jnp.where(eval_deny, gather_t(tm_ex_d, pt), gather_t(tm_ex_p, pt))
+    pol_tm_rg = jnp.where(eval_deny, gather_t(tm_rg_d, pt), gather_t(tm_rg_p, pt))
+    pol_gate = ~c["pol_has_target"] | jnp.where(exact[:, None], pol_tm_ex, pol_tm_rg)
+    pol_gate = pol_gate & c["pol_valid"]
+
+    # set gate: exact mode only, PERMIT variant (reference: :131-134)
+    set_gate = ~c["set_has_target"] | gather_t(tm_ex_p, c["set_target"])
+    set_gate = set_gate & c["set_valid"]  # [S]
+
+    pol_subject = ~c["pol_has_subjects"] | gather_t(hr_pass, pt)  # [S, KP]
+
+    # -------------------------------------------------- E: combine rule effects
+    scope = set_gate[:, None, None] & pol_gate[:, :, None]
+    abort_rule = reached & has_cond & cond_a & scope
+    matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
+    coll = matches & pol_subject[:, :, None] & scope  # [S, KP, KR]
+
+    KR = coll.shape[2]
+    kr_pos = jnp.arange(KR)[None, None, :]
+    first_deny = jnp.min(
+        jnp.where(coll & (c["rule_effect"] == 2), kr_pos, BIG), axis=2
+    )
+    first_permit = jnp.min(
+        jnp.where(coll & (c["rule_effect"] == 1), kr_pos, BIG), axis=2
+    )
+    first_coll = jnp.min(jnp.where(coll, kr_pos, BIG), axis=2)
+    last_coll = jnp.max(jnp.where(coll, kr_pos, -1), axis=2)
+    any_coll = coll.any(axis=2)
+
+    sel_do = jnp.where(first_deny < BIG, first_deny, last_coll)
+    sel_po = jnp.where(first_permit < BIG, first_permit, last_coll)
+    sel = jnp.select(
+        [c["pol_ca"] == 0, c["pol_ca"] == 1, c["pol_ca"] == 2],
+        [sel_do, sel_po, first_coll],
+        default=jnp.zeros_like(sel_do),
+    )
+    sel_c = jnp.clip(sel, 0, KR - 1)
+    rule_eff_sel = jnp.take_along_axis(c["rule_effect"], sel_c[:, :, None], axis=2)[
+        :, :, 0
+    ]
+    rule_cach_sel = jnp.take_along_axis(
+        c["rule_cacheable_eff"], sel_c[:, :, None], axis=2
+    )[:, :, 0]
+
+    no_rules_contrib = (
+        c["pol_valid"]
+        & set_gate[:, None]
+        & pol_gate
+        & (c["pol_n_rules"] == 0)
+        & (c["pol_effect"] > 0)
+    )
+    contrib_present = no_rules_contrib | any_coll
+    contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
+    contrib_cach = jnp.where(no_rules_contrib, c["pol_cacheable"], rule_cach_sel)
+
+    # ------------------------------------------------ F: combine policy effects
+    kp_pos2 = jnp.arange(KP)[None, :]
+    p_first_deny = jnp.min(
+        jnp.where(contrib_present & (contrib_eff == 2), kp_pos2, BIG), axis=1
+    )
+    p_first_permit = jnp.min(
+        jnp.where(contrib_present & (contrib_eff == 1), kp_pos2, BIG), axis=1
+    )
+    p_first = jnp.min(jnp.where(contrib_present, kp_pos2, BIG), axis=1)
+    p_last = jnp.max(jnp.where(contrib_present, kp_pos2, -1), axis=1)
+    set_any = contrib_present.any(axis=1)
+
+    s_sel_do = jnp.where(p_first_deny < BIG, p_first_deny, p_last)
+    s_sel_po = jnp.where(p_first_permit < BIG, p_first_permit, p_last)
+    s_sel = jnp.select(
+        [c["set_ca"] == 0, c["set_ca"] == 1, c["set_ca"] == 2],
+        [s_sel_do, s_sel_po, p_first],
+        default=jnp.zeros_like(s_sel_do),
+    )
+    s_sel_c = jnp.clip(s_sel, 0, KP - 1)
+    set_eff = jnp.take_along_axis(contrib_eff, s_sel_c[:, None], axis=1)[:, 0]
+    set_cach = jnp.take_along_axis(contrib_cach, s_sel_c[:, None], axis=1)[:, 0]
+
+    # ------------------------------------------------- G: last-set-wins + abort
+    S = set_eff.shape[0]
+    s_pos = jnp.arange(S)
+    winner = jnp.max(jnp.where(set_any, s_pos, -1))
+    have = winner >= 0
+    winner_c = jnp.clip(winner, 0, S - 1)
+    decision = jnp.where(have, jnp.take(set_eff, winner_c), 0)
+    cacheable = jnp.where(
+        have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
+    )
+    # effect present but neither PERMIT nor DENY folds to INDETERMINATE with
+    # the winning cacheable (reference: :312-318)
+    status = jnp.int32(200)
+
+    # condition aborts preempt everything, first in flat rule order
+    flat_order = (
+        jnp.arange(coll.shape[0])[:, None, None] * (KP * KR)
+        + jnp.arange(KP)[None, :, None] * KR
+        + jnp.arange(KR)[None, None, :]
+    )
+    abort_pos = jnp.min(jnp.where(abort_rule, flat_order, BIG))
+    has_abort = abort_pos < BIG
+    # gather the aborting rule's condition code and raw cacheable
+    abort_flat = jnp.clip(abort_pos, 0, coll.size - 1)
+    cond_c_flat = cond_c.reshape(-1)
+    cach_raw_flat = c["rule_cacheable_raw"].reshape(-1)
+    abort_code = jnp.take(cond_c_flat, abort_flat)
+    abort_cach = jnp.take(cach_raw_flat, abort_flat).astype(jnp.int32)
+
+    decision = jnp.where(has_abort, 2, decision)
+    cacheable = jnp.where(has_abort, abort_cach, cacheable)
+    status = jnp.where(has_abort, abort_code, status)
+
+    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+
+
+class DecisionKernel:
+    """Compiled-policy decision kernel with a jitted vmapped evaluate."""
+
+    def __init__(self, compiled: CompiledPolicies):
+        if not compiled.supported:
+            raise ValueError(
+                f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
+            )
+        self.compiled = compiled
+        self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+
+        def run(c, batch_arrays, rgx_set, pfx_neq, cond_true, cond_abort, cond_code):
+            # vmap over the leading batch axis of request arrays; regex
+            # matrices and compiled arrays are broadcast
+            in_axes = ({k: 0 for k in batch_arrays}, None, None, 0, 0, 0)
+
+            def one(ra, rs, pn, ct, ca, cc):
+                rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
+                      "cond_true": ct, "cond_abort": ca, "cond_code": cc}
+                return _evaluate_one(c, rr)
+
+            return jax.vmap(one, in_axes=in_axes)(
+                batch_arrays, rgx_set, pfx_neq,
+                cond_true.T, cond_abort.T, cond_code.T,
+            )
+
+        self._run = jax.jit(partial(run, self._c))
+
+    def evaluate(self, batch: RequestBatch):
+        """Returns (decision, cacheable, status) numpy arrays [B]."""
+        out = self._run(
+            {k: jnp.asarray(v) for k, v in batch.arrays.items()},
+            jnp.asarray(batch.rgx_set),
+            jnp.asarray(batch.pfx_neq),
+            jnp.asarray(batch.cond_true),
+            jnp.asarray(batch.cond_abort),
+            jnp.asarray(batch.cond_code),
+        )
+        return tuple(np.asarray(x) for x in out)

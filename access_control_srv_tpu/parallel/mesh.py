@@ -1,0 +1,106 @@
+"""Mesh construction and batch-axis sharding for the decision kernel.
+
+The parallel layout: requests are **data-parallel** over the ``data`` mesh
+axis; compiled policy tensors and regex matrices are replicated (they are
+the "model"); decisions gather back over ICI.  This is the TPU-native
+replacement for the reference's horizontal scaling of stateless Node
+replicas behind gRPC (SURVEY.md section 2.4): one process, N chips, one
+sharded batch.
+
+The kernel is pure and shape-static, so sharding is expressed entirely with
+``jax.sharding.NamedSharding`` on the batch axis — XLA inserts the
+collectives.  A rule-axis (model-parallel) shard_map variant is the planned
+extension for trees too large to replicate per chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.compile import CompiledPolicies
+from ..ops.encode import RequestBatch
+from ..ops.kernel import _evaluate_one
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def pad_batch(arrays: dict, B: int, multiple: int) -> tuple[dict, int]:
+    """Pad the leading batch axis up to a multiple (repeating row 0) so it
+    shards evenly; returns (padded arrays, padded size)."""
+    if B % multiple == 0:
+        return arrays, B
+    pad = multiple - (B % multiple)
+    out = {}
+    for k, v in arrays.items():
+        pad_rows = np.repeat(v[:1], pad, axis=0)
+        out[k] = np.concatenate([v, pad_rows], axis=0)
+    return out, B + pad
+
+
+class ShardedDecisionKernel:
+    """The decision kernel jitted with batch-axis sharding over a mesh."""
+
+    def __init__(self, compiled: CompiledPolicies, mesh: Mesh, axis: str = "data"):
+        if not compiled.supported:
+            raise ValueError(
+                f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
+            )
+        self.compiled = compiled
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = mesh.devices.size
+        self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+        self._batch_sharding = NamedSharding(mesh, P(axis))
+        self._repl = NamedSharding(mesh, P())
+
+        c = self._c
+
+        def run(batch_arrays, rgx_set, pfx_neq):
+            # batch_arrays carries the per-request encodings plus the
+            # transposed condition bits (cond_true/abort/code as [B, C])
+            def one(ra):
+                rr = {
+                    **{k: v for k, v in ra.items() if not k.startswith("cond_")},
+                    "rgx_set": rgx_set,
+                    "pfx_neq": pfx_neq,
+                    "cond_true": ra["cond_true"],
+                    "cond_abort": ra["cond_abort"],
+                    "cond_code": ra["cond_code"],
+                }
+                return _evaluate_one(c, rr)
+
+            return jax.vmap(one)(batch_arrays)
+
+        self._run = jax.jit(
+            run,
+            in_shardings=(None, self._repl, self._repl),
+            out_shardings=(
+                self._batch_sharding,
+                self._batch_sharding,
+                self._batch_sharding,
+            ),
+        )
+
+    def evaluate(self, batch: RequestBatch):
+        arrays = dict(batch.arrays)
+        arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
+        arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
+        arrays["cond_code"] = np.ascontiguousarray(batch.cond_code.T)
+        arrays, _ = pad_batch(arrays, batch.B, self.n_devices)
+        dev_arrays = {
+            k: jax.device_put(v, self._batch_sharding) for k, v in arrays.items()
+        }
+        out = self._run(
+            dev_arrays,
+            jnp.asarray(batch.rgx_set),
+            jnp.asarray(batch.pfx_neq),
+        )
+        return tuple(np.asarray(x)[: batch.B] for x in out)

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched isAllowed decisions/sec on one chip.
+
+Config mirrors BASELINE.md config 2/5 shape: the seed policy set (super-
+admin permit-all, data/seed_data/) evaluated against a large synthetic
+request batch (50% super-admin role -> PERMIT, 50% ordinary -> INDETERMINATE)
+on whatever accelerator jax.devices() exposes (the driver runs this on a
+single TPU v5e-1 chip).
+
+Prints ONE JSON line:
+  {"metric": "isAllowed decisions/sec/chip (seed policy set)",
+   "value": <decisions/sec>, "unit": "decisions/s",
+   "vs_baseline": <value / 100_000>}
+
+vs_baseline is measured against the BASELINE.json north-star target of
+100k decisions/sec/chip (the reference publishes no numbers; its scalar
+TypeScript engine evaluates one request per gRPC call).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_TARGET = 100_000.0
+
+
+def build_batch(compiled, base: int = 4096, total: int = 1 << 18):
+    """Encode `base` distinct requests, then tile to `total` at array level
+    (string work is per-distinct-request; device work is per-row)."""
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.ops import encode_requests
+    from access_control_srv_tpu.ops.encode import RequestBatch
+
+    urns = Urns()
+    org = "urn:restorecommerce:acs:model:organization.Organization"
+    entities = [
+        org,
+        "urn:restorecommerce:acs:model:user.User",
+        "urn:restorecommerce:acs:model:address.Address",
+        "urn:restorecommerce:acs:model:location.Location",
+    ]
+    actions = [urns["read"], urns["modify"], urns["create"], urns["delete"]]
+    rng = np.random.default_rng(42)
+    requests = []
+    for i in range(base):
+        role = "superadministrator-r-id" if i % 2 == 0 else f"role-{i % 7}"
+        entity = entities[int(rng.integers(len(entities)))]
+        requests.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=urns["role"], value=role),
+                        Attribute(id=urns["subjectID"], value=f"user-{i % 512}"),
+                    ],
+                    resources=[
+                        Attribute(id=urns["entity"], value=entity),
+                        Attribute(id=urns["resourceID"], value=f"res-{i % 1024}"),
+                    ],
+                    actions=[
+                        Attribute(
+                            id=urns["actionID"],
+                            value=actions[int(rng.integers(len(actions)))],
+                        )
+                    ],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"user-{i % 512}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    batch = encode_requests(requests, compiled)
+    assert bool(batch.eligible.all()), "bench requests must be kernel-eligible"
+
+    reps = (total + base - 1) // base
+    arrays = {k: np.tile(v, (reps,) + (1,) * (v.ndim - 1))[:total]
+              for k, v in batch.arrays.items()}
+    C = batch.cond_true.shape[0]
+    return RequestBatch(
+        B=total,
+        arrays=arrays,
+        rgx_set=batch.rgx_set,
+        pfx_neq=batch.pfx_neq,
+        cond_true=np.tile(batch.cond_true, (1, reps))[:, :total],
+        cond_abort=np.tile(batch.cond_abort, (1, reps))[:, :total],
+        cond_code=np.tile(batch.cond_code, (1, reps))[:, :total],
+        eligible=np.ones((total,), bool),
+    )
+
+
+def main():
+    import jax
+
+    from access_control_srv_tpu.core import AccessController, load_seed_files
+    from access_control_srv_tpu.ops import DecisionKernel, compile_policies
+
+    engine = AccessController()
+    seed = os.path.join(REPO, "data", "seed_data")
+    for ps in load_seed_files(
+        os.path.join(seed, "policy_sets.yaml"),
+        os.path.join(seed, "policies.yaml"),
+        os.path.join(seed, "rules.yaml"),
+    ):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    kernel = DecisionKernel(compiled)
+
+    total = int(os.environ.get("BENCH_BATCH", 1 << 18))
+    batch = build_batch(compiled, total=total)
+
+    import jax.numpy as jnp
+
+    dev_args = (
+        {k: jnp.asarray(v) for k, v in batch.arrays.items()},
+        jnp.asarray(batch.rgx_set),
+        jnp.asarray(batch.pfx_neq),
+        jnp.asarray(batch.cond_true),
+        jnp.asarray(batch.cond_abort),
+        jnp.asarray(batch.cond_code),
+    )
+    # warmup / compile
+    out = kernel._run(*dev_args)
+    jax.block_until_ready(out)
+    # sanity: 50% PERMIT, 50% INDETERMINATE
+    dec = np.asarray(out[0])
+    permit_frac = float((dec == 1).mean())
+    assert 0.45 < permit_frac < 0.55, permit_frac
+
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel._run(*dev_args)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    value = total * iters / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "isAllowed decisions/sec/chip (seed policy set)",
+                "value": round(value, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(value / BASELINE_TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,22 @@
 """Test-session environment: force the CPU platform with 8 virtual devices
-so multi-chip sharding paths compile and run without TPU hardware."""
+so multi-chip sharding paths compile and run without TPU hardware.
+
+Note: this machine pre-sets JAX_PLATFORMS=axon (the TPU tunnel); the env
+var is overridden externally, so the platform must be forced through
+jax.config instead."""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,301 @@
+"""Differential tests: the batched JAX kernel must produce decisions
+identical to the scalar oracle for every kernel-eligible request.
+
+This is the framework's substitute for the reference's race-detection /
+sanitizer class (SURVEY.md section 5): the oracle is the normative
+semantics; the kernel is property-tested against it on fixture-driven
+grids and randomized policies/requests."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from access_control_srv_tpu.core import AccessController, populate
+from access_control_srv_tpu.models import Attribute, Request, Target
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    compile_policies,
+    encode_requests,
+)
+
+from .utils import URNS, build_request, fixture, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+ADDR = "urn:restorecommerce:acs:model:address.Address"
+LOC = "urn:restorecommerce:acs:model:location.Location"
+WIDGET = "urn:restorecommerce:acs:model:widget.Widget"
+
+DEC_CODE = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+
+SUBJECTS = ["ada", "ben", "gil", "dee", "eva", "kai", "zoe"]
+ROLES = ["member", "manager", "guest"]
+ENTITIES = [ORG, USER, ADDR, LOC, WIDGET]
+ACTIONS = [URNS["read"], URNS["modify"], URNS["create"], URNS["delete"],
+           URNS["execute"]]
+PROPS = [ORG + "#name", ORG + "#secret_field", USER + "#name",
+         USER + "#password", ADDR + "#street", LOC + "#address"]
+OWNERS = ["Org1", "Org2", "Org3", "Org4", "SuperOrg1", "otherOrg"]
+
+
+def run_differential(engine: AccessController, requests: list[Request]):
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    kernel = DecisionKernel(compiled)
+    batch = encode_requests(requests, compiled)
+    decision, cacheable, status = kernel.evaluate(batch)
+
+    n_eligible = 0
+    for b, request in enumerate(requests):
+        expected = engine.is_allowed(request)
+        if not batch.eligible[b]:
+            continue
+        n_eligible += 1
+        assert decision[b] == DEC_CODE[expected.decision], (
+            f"request {b}: kernel={decision[b]} oracle={expected.decision} "
+        )
+        exp_cach = expected.evaluation_cacheable
+        exp_code = -1 if exp_cach is None else int(bool(exp_cach))
+        assert cacheable[b] == exp_code, (
+            f"request {b}: cacheable kernel={cacheable[b]} oracle={exp_cach}"
+        )
+        assert status[b] == expected.operation_status.code, (
+            f"request {b}: status kernel={status[b]} "
+            f"oracle={expected.operation_status.code}"
+        )
+    return n_eligible
+
+
+def grid_requests(n=None, seed=7):
+    """A randomized sweep over the request option space."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n or 160):
+        action = rng.choice(ACTIONS)
+        multi = rng.random() < 0.3 and action != URNS["execute"]
+        if action == URNS["execute"]:
+            rtype = rng.choice(["mutation.runPipeline", "mutation.other"])
+            rid = rtype
+        elif multi:
+            rtype = rng.sample(ENTITIES, 2)
+            rid = [f"id-{i}" for i in range(2)]
+        else:
+            rtype = rng.choice(ENTITIES)
+            rid = "id-0"
+        prop = None
+        if rng.random() < 0.5 and action != URNS["execute"]:
+            prop = rng.sample(PROPS, rng.randint(1, 2))
+        owner = None
+        owner_ent = None
+        if rng.random() < 0.7:
+            owner_ent = ORG
+            owner = (
+                [rng.choice(OWNERS) for _ in range(2)]
+                if multi
+                else rng.choice(OWNERS)
+            )
+        out.append(
+            build_request(
+                subject_id=rng.choice(SUBJECTS),
+                subject_role=rng.choice(ROLES),
+                role_scoping_entity=ORG,
+                role_scoping_instance=rng.choice(OWNERS),
+                resource_type=rtype,
+                resource_id=rid,
+                resource_property=prop,
+                action_type=action,
+                owner_indicatory_entity=owner_ent,
+                owner_instance=owner,
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize(
+    "fixture_name",
+    [
+        "basic_policies.yml",
+        "policy_targets.yml",
+        "policy_set_targets.yml",
+        "role_scopes.yml",
+        "hr_disabled.yml",
+        "conditions.yml",
+    ],
+)
+def test_fixture_differential(fixture_name):
+    engine = make_engine(fixture_name)
+    n = run_differential(engine, grid_requests())
+    assert n > 100  # the sweep must actually exercise the kernel
+
+
+def test_multi_fixture_tree():
+    """All fixtures loaded into one engine: multiple policy sets,
+    last-set-wins interactions."""
+    engine = make_engine()
+    for name in ["basic_policies.yml", "policy_targets.yml", "role_scopes.yml"]:
+        populate(engine, fixture(name))
+    n = run_differential(engine, grid_requests(seed=11))
+    assert n > 100
+
+
+def _random_policy_tree(rng: random.Random):
+    """Generate a random policy tree within the kernel-supported subset."""
+    cas = [
+        "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:deny-overrides",
+        "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides",
+        "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:first-applicable",
+    ]
+
+    def maybe_target(allow_scoping=True):
+        t = {}
+        if rng.random() < 0.6:
+            subs = []
+            if rng.random() < 0.5:
+                subs.append({"id": URNS["subjectID"], "value": rng.choice(SUBJECTS)})
+            else:
+                subs.append({"id": URNS["role"], "value": rng.choice(ROLES)})
+                if allow_scoping and rng.random() < 0.6:
+                    subs.append({"id": URNS["roleScopingEntity"], "value": ORG})
+                    if rng.random() < 0.3:
+                        subs.append(
+                            {"id": URNS["hierarchicalRoleScoping"], "value": "false"}
+                        )
+            t["subjects"] = subs
+        if rng.random() < 0.7:
+            res = []
+            if rng.random() < 0.85:
+                res.append({"id": URNS["entity"], "value": rng.choice(ENTITIES)})
+                for p in rng.sample(PROPS, rng.randint(0, 2)):
+                    res.append({"id": URNS["property"], "value": p})
+            else:
+                res.append(
+                    {"id": URNS["operation"], "value": "mutation.runPipeline"}
+                )
+            t["resources"] = res
+        if rng.random() < 0.6:
+            t["actions"] = [
+                {"id": URNS["actionID"], "value": rng.choice(ACTIONS)}
+            ]
+        return t or None
+
+    doc = {"policy_sets": []}
+    for s in range(rng.randint(1, 3)):
+        ps = {
+            "id": f"ps{s}",
+            "combining_algorithm": rng.choice(cas),
+            "policies": [],
+        }
+        if rng.random() < 0.3:
+            tgt = maybe_target(allow_scoping=False)
+            if tgt:
+                ps["target"] = tgt
+        for p in range(rng.randint(1, 3)):
+            pol = {
+                "id": f"ps{s}p{p}",
+                "combining_algorithm": rng.choice(cas),
+            }
+            if rng.random() < 0.4:
+                tgt = maybe_target()
+                if tgt:
+                    pol["target"] = tgt
+            if rng.random() < 0.25:
+                pol["effect"] = rng.choice(["PERMIT", "DENY"])
+            else:
+                pol["rules"] = []
+                for q in range(rng.randint(1, 4)):
+                    rule = {
+                        "id": f"ps{s}p{p}r{q}",
+                        "effect": rng.choice(["PERMIT", "DENY"]),
+                    }
+                    if rng.random() < 0.3:
+                        rule["evaluation_cacheable"] = True
+                    tgt = maybe_target()
+                    if tgt:
+                        rule["target"] = tgt
+                    pol["rules"].append(rule)
+            ps["policies"].append(pol)
+        doc["policy_sets"].append(ps)
+    return doc
+
+
+def test_acl_failure_paths_differential():
+    """Requests with no resourceID/operation attributes exercise
+    verify_acl's pre-ACL failure paths (empty role associations -> False,
+    non-CRUD action -> False); the kernel must agree with the oracle."""
+    engine = make_engine("policy_targets.yml")
+    requests = []
+    for role_assocs in ([], [{"role": "member", "attributes": []}]):
+        for action in [URNS["read"], URNS["modify"], "custom:action", None]:
+            req = Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=URNS["role"], value="member"),
+                        Attribute(id=URNS["subjectID"], value="ada"),
+                    ],
+                    # entity attribute only: no resourceID
+                    resources=[Attribute(id=URNS["entity"], value=ORG)],
+                    actions=(
+                        [Attribute(id=URNS["actionID"], value=action)]
+                        if action
+                        else []
+                    ),
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": "ada",
+                        "role_associations": role_assocs,
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+            requests.append(req)
+    n = run_differential(engine, requests)
+    assert n == len(requests)  # all must stay kernel-eligible
+
+
+def test_missing_hierarchical_scopes_falls_back():
+    """hierarchical_scopes missing + role associations present makes the
+    oracle raise; such requests must not stay kernel-eligible."""
+    engine = make_engine("policy_targets.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    req = Request(
+        target=Target(
+            subjects=[
+                Attribute(id=URNS["role"], value="member"),
+                Attribute(id=URNS["subjectID"], value="ada"),
+            ],
+            resources=[Attribute(id=URNS["entity"], value=ORG)],
+            actions=[Attribute(id=URNS["actionID"], value=URNS["read"])],
+        ),
+        context={
+            "resources": [],
+            "subject": {
+                "id": "ada",
+                "role_associations": [{"role": "member", "attributes": []}],
+            },
+        },
+    )
+    batch = encode_requests([req], compiled)
+    assert not batch.eligible[0]
+
+
+def test_randomized_differential():
+    from access_control_srv_tpu.core.loader import load_policy_sets
+
+    rng = random.Random(1234)
+    total_eligible = 0
+    for round_ in range(12):
+        doc = _random_policy_tree(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        total_eligible += run_differential(
+            engine, grid_requests(n=60, seed=1000 + round_)
+        )
+    assert total_eligible > 300
