@@ -165,7 +165,22 @@ def _validate_condition_ast(tree: ast.AST) -> None:
             raise ConditionValidationError(
                 f"statement {type(node).__name__} is not allowed in conditions"
             )
-        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "format",
+            "format_map",
+        ):
+            # str.format traverses dunder attribute chains at runtime
+            # ("{0.__class__...}"), bypassing the static dunder ban
+            raise ConditionValidationError(
+                f"calling {node.attr!r} is not allowed in conditions"
+            )
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr.startswith("_")
+            # _queryResult is the documented context-query graft point
+            # (reference: accessController.ts:959-965)
+            and node.attr != "_queryResult"
+        ):
             raise ConditionValidationError(
                 f"access to {node.attr!r} is not allowed in conditions"
             )
@@ -179,6 +194,45 @@ def _validate_condition_ast(tree: ast.AST) -> None:
                 raise ConditionValidationError(
                     f"calling {fn.id!r} is not allowed in conditions"
                 )
+
+
+class ConditionBudgetExceeded(RuntimeError):
+    code = 500
+
+
+class _ExecutionBudget:
+    """Caps the traced line/call events of a condition evaluation so a
+    hostile/broken condition (``while True``, huge ranges) cannot hang the
+    PDP; the engine converts the raised error into deny-by-default."""
+
+    def __init__(self, max_events: int):
+        self.remaining = max_events
+        self._previous = None
+
+    def _trace(self, frame, event, arg):
+        if event in ("line", "call"):
+            self.remaining -= 1
+            if self.remaining <= 0:
+                raise ConditionBudgetExceeded(
+                    "condition exceeded its execution budget"
+                )
+        return self._trace
+
+    def __enter__(self):
+        import sys
+
+        self._previous = sys.gettrace()
+        sys.settrace(self._trace)
+        return self
+
+    def __exit__(self, *exc):
+        import sys
+
+        sys.settrace(self._previous)
+        return False
+
+
+CONDITION_MAX_EVENTS = 200_000
 
 
 def condition_matches(condition: str, request) -> bool:
@@ -206,17 +260,19 @@ def condition_matches(condition: str, request) -> bool:
         is_expression = False
     _validate_condition_ast(tree)
 
-    if is_expression:
-        result = eval(compile(tree, "<condition>", "eval"), env)
-    else:
-        exec(compile(tree, "<condition>", "exec"), env)
-        check = env.get("check")
-        if not callable(check):
-            raise ConditionValidationError(
-                "multi-line condition must define check(request, target, context)"
-            )
-        return bool(check(request, env["target"], env["context"]))
+    with _ExecutionBudget(CONDITION_MAX_EVENTS):
+        if is_expression:
+            result = eval(compile(tree, "<condition>", "eval"), env)
+        else:
+            exec(compile(tree, "<condition>", "exec"), env)
+            check = env.get("check")
+            if not callable(check):
+                raise ConditionValidationError(
+                    "multi-line condition must define "
+                    "check(request, target, context)"
+                )
+            return bool(check(request, env["target"], env["context"]))
 
-    if callable(result):
-        return bool(result(request, env["target"], env["context"]))
+        if callable(result):
+            return bool(result(request, env["target"], env["context"]))
     return bool(result)

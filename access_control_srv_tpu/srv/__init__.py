@@ -1,0 +1,30 @@
+"""Serving shell: policy store with CRUD + hot recompile, command
+interface, subject/HR-scope cache, micro-batching evaluator and the
+composition-root worker (reference: src/worker.ts, src/resourceManager.ts,
+src/accessControlService.ts)."""
+
+from .config import Config
+from .events import EventBus, Topic
+from .cache import SubjectCache, HRScopeProvider
+from .identity import IdentityClient, StaticIdentityClient
+from .evaluator import HybridEvaluator
+from .store import PolicyStore, ResourceService
+from .service import AccessControlService
+from .command import CommandInterface
+from .worker import Worker
+
+__all__ = [
+    "Config",
+    "EventBus",
+    "Topic",
+    "SubjectCache",
+    "HRScopeProvider",
+    "IdentityClient",
+    "StaticIdentityClient",
+    "HybridEvaluator",
+    "PolicyStore",
+    "ResourceService",
+    "AccessControlService",
+    "CommandInterface",
+    "Worker",
+]

@@ -1,0 +1,113 @@
+"""Resource adapters for rule context queries.
+
+Framework analog of the reference's ResourceAdapter + GraphQLAdapter
+(reference: src/core/resource_adapters/{adapter,gql}.ts): a rule may carry
+a ``context_query`` whose result is pulled before condition evaluation and
+grafted onto the request context under ``_queryResult``.
+
+The GraphQL implementation resolves filter property references against the
+request's context resources (reference: gql.ts:30-55), POSTs the query and
+unwraps the ``details`` payloads (reference: gql.ts:66-89).  The HTTP layer
+is injectable (tests pass a transport callable; production uses stdlib
+urllib).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+from ..core.common import get_field as _get
+from ..core.errors import UnexpectedContextQueryResponse, UnsupportedResourceAdapter
+
+
+class ResourceAdapter:
+    def query(self, context_query, request) -> Any:
+        raise NotImplementedError
+
+
+class GraphQLAdapter(ResourceAdapter):
+    def __init__(
+        self,
+        url: str,
+        logger=None,
+        client_opts: dict | None = None,
+        transport: Optional[Callable[[str, bytes, dict], bytes]] = None,
+    ):
+        self.url = url
+        self.logger = logger
+        self.client_opts = client_opts or {}
+        self.transport = transport or self._http_post
+
+    def _http_post(self, url: str, body: bytes, headers: dict) -> bytes:
+        import urllib.request
+
+        req = urllib.request.Request(url, data=body, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read()
+
+    def _resolve_filters(self, context_query, request) -> dict:
+        """Filter values referencing request resource properties are
+        resolved from the context resources (reference: gql.ts:30-55)."""
+        variables: dict = {}
+        filters = []
+        ctx_resources = _get(request.context, "resources") or []
+        for filt in getattr(context_query, "filters", None) or []:
+            field = _get(filt, "field")
+            value = _get(filt, "value")
+            operation = _get(filt, "operation") or "eq"
+            if isinstance(value, str) and value.startswith("$"):
+                prop = value[1:]
+                resolved = None
+                for res in ctx_resources:
+                    node = res
+                    found = True
+                    for part in prop.split("."):
+                        node = _get(node, part)
+                        if node is None:
+                            found = False
+                            break
+                    if found:
+                        resolved = node
+                        break
+                value = resolved
+            filters.append({"field": field, "operation": operation, "value": value})
+        if filters:
+            variables["filters"] = filters
+        return variables
+
+    def query(self, context_query, request) -> Any:
+        gql_query = getattr(context_query, "query", "") or ""
+        variables = self._resolve_filters(context_query, request)
+        body = json.dumps({"query": gql_query, "variables": variables}).encode()
+        headers = {"Content-Type": "application/json"}
+        headers.update(self.client_opts.get("headers", {}))
+        raw = self.transport(self.url, body, headers)
+        try:
+            payload = json.loads(raw)
+        except (TypeError, ValueError) as exc:
+            raise UnexpectedContextQueryResponse(str(exc)) from exc
+        data = payload.get("data")
+        if not isinstance(data, dict) or not data:
+            raise UnexpectedContextQueryResponse("missing data")
+        # unwrap the first operation's details payloads (reference: gql.ts:82-89)
+        first = next(iter(data.values()))
+        details = _get(first, "details")
+        if details is None:
+            raise UnexpectedContextQueryResponse("missing details")
+        out = []
+        for item in details:
+            payload_item = _get(item, "payload")
+            out.append(payload_item if payload_item is not None else item)
+        return out
+
+
+def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
+    """(reference: accessController.ts:943-951)"""
+    if adapter_config and adapter_config.get("graphql"):
+        opts = adapter_config["graphql"]
+        return GraphQLAdapter(
+            opts.get("url", ""), logger, opts.get("clientOpts"),
+            transport=opts.get("transport"),
+        )
+    raise UnsupportedResourceAdapter(adapter_config)

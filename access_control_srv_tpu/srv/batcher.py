@@ -1,0 +1,85 @@
+"""Micro-batching frontend for isAllowed.
+
+The reference evaluates one request per gRPC call
+(reference: src/accessControlService.ts:62-81); the TPU path earns its
+throughput by batching.  Concurrent callers submit requests; a collector
+drains the queue every ``window_ms`` (or at ``max_batch``) and evaluates
+the whole batch through the hybrid evaluator, resolving each caller's
+future.  Single outstanding requests skip the device path entirely (the
+oracle answers faster than an encode + device round-trip)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+from ..models.model import Request, Response
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        evaluator,
+        window_ms: float = 2.0,
+        max_batch: int = 4096,
+        min_kernel_batch: int = 8,
+    ):
+        self.evaluator = evaluator
+        self.window_s = window_ms / 1000.0
+        self.max_batch = max_batch
+        self.min_kernel_batch = min_kernel_batch
+        self._queue: "queue.Queue[tuple[Request, Future]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def submit(self, request: Request) -> "Future[Response]":
+        future: "Future[Response]" = Future()
+        self._queue.put((request, future))
+        return future
+
+    def is_allowed(self, request: Request, timeout: float = 30.0) -> Response:
+        return self.submit(request).result(timeout=timeout)
+
+    # ----------------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = self.window_s
+            try:
+                while len(batch) < self.max_batch:
+                    item = self._queue.get(timeout=deadline)
+                    batch.append(item)
+            except queue.Empty:
+                pass
+            requests = [req for req, _ in batch]
+            try:
+                if len(batch) < self.min_kernel_batch:
+                    responses = [
+                        self.evaluator.is_allowed(req) for req in requests
+                    ]
+                else:
+                    responses = self.evaluator.is_allowed_batch(requests)
+                for (_, future), response in zip(batch, responses):
+                    future.set_result(response)
+            except Exception as err:  # pragma: no cover
+                for _, future in batch:
+                    if not future.done():
+                        future.set_exception(err)

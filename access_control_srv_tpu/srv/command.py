@@ -1,0 +1,124 @@
+"""Command interface: operational commands over the service.
+
+Framework analog of the reference's chassis CommandInterface subclass
+(reference: src/accessControlService.ts:129-150 + chassis-srv command
+interface): restore / reset / version / health_check / config_update /
+flush_cache / set_api_key, each also invocable via the command topic.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from .. import __version__
+
+
+class CommandInterface:
+    def __init__(self, cfg, service, store=None, bus=None, cache=None, logger=None):
+        self.cfg = cfg
+        self.service = service
+        self.store = store
+        self.cache = cache
+        self.logger = logger
+        self.api_key: Optional[str] = None
+        self.start_time = time.time()
+        if bus is not None:
+            bus.topic("io.restorecommerce.command").on(self._on_command)
+
+    def _on_command(self, event_name: str, message: Any, ctx: dict) -> None:
+        if event_name != "command":
+            return
+        name = (message or {}).get("name")
+        payload = (message or {}).get("payload")
+        if isinstance(payload, dict) and "value" in payload:
+            raw = payload["value"]
+            if isinstance(raw, (bytes, bytearray)):
+                raw = raw.decode()
+            try:
+                payload = json.loads(raw)
+            except (TypeError, ValueError):
+                payload = {}
+        self.command(name, payload or {})
+
+    def command(self, name: str, payload: dict | None = None) -> dict:
+        payload = payload or {}
+        handler = {
+            "restore": self.restore,
+            "reset": self.reset,
+            "version": self.version,
+            "health_check": self.health_check,
+            "config_update": self.config_update,
+            "flush_cache": self.flush_cache,
+            "set_api_key": self.set_api_key,
+        }.get(name)
+        if handler is None:
+            return {"error": f"unknown command {name!r}"}
+        return handler(payload)
+
+    # -------------------------------------------------------------- commands
+
+    def restore(self, payload: dict) -> dict:
+        """Reload resource state, then clear + reload the in-memory policy
+        tree (reference: accessControlService.ts:137-143)."""
+        if self.store is not None:
+            self.store.load()
+        else:
+            self.service.engine.clear_policies()
+            self.service.load_policies()
+        return {"status": "restored"}
+
+    def reset(self, payload: dict) -> dict:
+        """Clear state, then reload policies
+        (reference: accessControlService.ts:144-149)."""
+        self.service.engine.clear_policies()
+        if self.store is not None:
+            for collection in self.store.collections.values():
+                collection.clear()
+            self.store.load()
+        if self.service.evaluator is not None:
+            self.service.evaluator.refresh()
+        return {"status": "reset"}
+
+    def version(self, payload: dict) -> dict:
+        return {"version": __version__, "name": self.cfg.get("service:name")}
+
+    def health_check(self, payload: dict) -> dict:
+        """Readiness = the policy tree is present and the evaluator answers
+        (the Arango-readiness analog, reference: src/worker.ts:189-194)."""
+        healthy = True
+        detail = {}
+        try:
+            detail["policy_sets"] = len(self.service.engine.policy_sets)
+            evaluator = self.service.evaluator
+            if evaluator is not None:
+                detail["kernel_active"] = evaluator.kernel_active
+        except Exception as err:  # pragma: no cover
+            healthy = False
+            detail["error"] = str(err)
+        return {
+            "status": "SERVING" if healthy else "NOT_SERVING",
+            "uptime_s": round(time.time() - self.start_time, 3),
+            **detail,
+        }
+
+    def config_update(self, payload: dict) -> dict:
+        for path, value in (payload or {}).items():
+            self.cfg.set(path, value)
+        return {"status": "updated", "keys": list((payload or {}).keys())}
+
+    def flush_cache(self, payload: dict) -> dict:
+        """(reference: chassis flush_cache + utils.ts flushACSCache)"""
+        data = (payload or {}).get("data", payload) or {}
+        pattern = data.get("pattern", "")
+        count = 0
+        if self.cache is not None:
+            count = self.cache.evict_prefix(f"cache:{pattern}" if pattern else "")
+        return {"status": "flushed", "evicted": count}
+
+    def set_api_key(self, payload: dict) -> dict:
+        self.api_key = (payload or {}).get("authentication", {}).get("apiKey") or (
+            payload or {}
+        ).get("apiKey")
+        return {"status": "set"}

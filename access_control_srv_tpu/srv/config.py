@@ -1,0 +1,87 @@
+"""Layered configuration with colon-path access.
+
+Framework analog of the reference's nconf-style service-config
+(reference: @restorecommerce/service-config usage, cfg.get('a:b:c') across
+src/worker.ts and src/core): a base document overlaid with an environment
+document (config_{ENV}.json) and runtime ``set`` mutations (tests mutate
+config live, reference: test/microservice.spec.ts:91-93).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any
+
+DEFAULT_CONFIG: dict = {
+    "service": {"name": "access-control-srv-tpu"},
+    "authorization": {
+        "enabled": False,
+        "enforce": False,
+        "hrReqTimeout": 300_000,
+    },
+    "policies": {
+        "type": "local",  # local | database
+        "options": {"urns": {}, "combiningAlgorithms": []},
+    },
+    "evaluator": {
+        "backend": "hybrid",  # oracle | kernel | hybrid
+        "micro_batch_window_ms": 2,
+        "micro_batch_max": 4096,
+    },
+    "seed_data": None,
+    "server": {"transports": [{"provider": "grpc", "addr": "0.0.0.0:50061"}]},
+    "redis": {"db-indexes": {"db-subject": 4}},
+    "adapter": {},
+    "logger": {"maskFields": ["password", "token"]},
+}
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = copy.deepcopy(base)
+    for key, value in overlay.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = copy.deepcopy(value)
+    return out
+
+
+class Config:
+    def __init__(self, data: dict | None = None, env: str | None = None):
+        self._data = _deep_merge(DEFAULT_CONFIG, data or {})
+        self.env = env or os.environ.get("ACS_ENV", "")
+
+    @classmethod
+    def load(cls, directory: str, env: str | None = None) -> "Config":
+        env = env or os.environ.get("ACS_ENV", "")
+        data: dict = {}
+        base = os.path.join(directory, "config.json")
+        if os.path.exists(base):
+            with open(base) as fh:
+                data = json.load(fh)
+        if env:
+            overlay_path = os.path.join(directory, f"config_{env}.json")
+            if os.path.exists(overlay_path):
+                with open(overlay_path) as fh:
+                    data = _deep_merge(data, json.load(fh))
+        return cls(data, env=env)
+
+    def get(self, path: str, default: Any = None) -> Any:
+        node: Any = self._data
+        for part in path.split(":"):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def set(self, path: str, value: Any) -> None:
+        parts = path.split(":")
+        node = self._data
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+
+    def as_dict(self) -> dict:
+        return copy.deepcopy(self._data)

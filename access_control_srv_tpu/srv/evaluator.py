@@ -1,0 +1,123 @@
+"""Hybrid evaluator: the TPU kernel fast path fused with the scalar oracle.
+
+Batched ``isAllowed`` requests flow through the compiled kernel; requests
+outside the kernel's representable subset (or whole trees the compiler
+rejects) fall back to the oracle — decisions are bit-identical either way
+(enforced by the differential suite).  Kernel rows that abort with an error
+status are re-run on the oracle to recover the exact error message the
+reference would produce (the kernel computes codes, not message strings).
+
+Hot policy mutation triggers a recompile; serving is version-pinned: the
+old kernel keeps answering until the new compile (optionally off-thread)
+is swapped in atomically (the reference just mutates Maps in place,
+reference: src/core/accessController.ts:897-937 — we must not stall
+serving on an XLA compile).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core.engine import AccessController
+from ..models.model import Decision, OperationStatus, Response
+from ..ops.compile import DECISION_NAMES, compile_policies
+from ..ops.encode import encode_requests
+from ..ops.kernel import DecisionKernel
+
+
+class HybridEvaluator:
+    def __init__(
+        self,
+        engine: AccessController,
+        backend: str = "hybrid",  # oracle | kernel | hybrid
+        logger=None,
+        async_compile: bool = False,
+    ):
+        self.engine = engine
+        self.backend = backend
+        self.logger = logger
+        self.async_compile = async_compile
+        self._version = 0
+        self._compiled = None
+        self._kernel: Optional[DecisionKernel] = None
+        self._lock = threading.Lock()
+        self._compile_thread: Optional[threading.Thread] = None
+        if backend != "oracle":
+            self.refresh(wait=True)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def refresh(self, wait: bool = False) -> None:
+        """Recompile the policy tensors after a tree mutation; the previous
+        kernel serves until the swap."""
+        if self.backend == "oracle":
+            return
+        with self._lock:
+            self._version += 1
+            version = self._version
+
+        def compile_and_swap():
+            compiled = compile_policies(
+                self.engine.policy_sets, self.engine.urns, version=version
+            )
+            kernel = None
+            if compiled.supported and compiled.n_rules > 0:
+                kernel = DecisionKernel(compiled)
+            with self._lock:
+                if version >= self._version:  # drop stale compiles
+                    self._compiled = compiled
+                    self._kernel = kernel
+            if self.logger and not compiled.supported:
+                self.logger.warning(
+                    "policy tree not kernel-supported; serving from oracle",
+                    extra={"reason": compiled.unsupported_reason},
+                )
+
+        if self.async_compile and not wait:
+            thread = threading.Thread(target=compile_and_swap, daemon=True)
+            thread.start()
+            self._compile_thread = thread
+        else:
+            compile_and_swap()
+
+    @property
+    def kernel_active(self) -> bool:
+        return self._kernel is not None
+
+    # ------------------------------------------------------------ evaluation
+
+    def is_allowed(self, request) -> Response:
+        """Single-request path: the oracle wins below batch sizes where the
+        device round-trip pays off."""
+        return self.engine.is_allowed(request)
+
+    def what_is_allowed(self, request):
+        return self.engine.what_is_allowed(request)
+
+    def is_allowed_batch(self, requests: list) -> list[Response]:
+        with self._lock:
+            kernel = self._kernel
+            compiled = self._compiled
+        if self.backend == "oracle" or kernel is None:
+            return [self.engine.is_allowed(r) for r in requests]
+
+        batch = encode_requests(requests, compiled, self.engine.resource_adapter)
+        decision, cacheable, status = kernel.evaluate(batch)
+        responses: list[Response] = []
+        for b, request in enumerate(requests):
+            if not batch.eligible[b] or status[b] != 200:
+                # ineligible rows and error-status rows take the oracle path
+                # (the latter to recover exact error messages)
+                responses.append(self.engine.is_allowed(request))
+                continue
+            cach = None if cacheable[b] < 0 else bool(cacheable[b])
+            responses.append(
+                Response(
+                    decision=DECISION_NAMES[int(decision[b])],
+                    obligations=[],
+                    evaluation_cacheable=cach,
+                    operation_status=OperationStatus(),
+                )
+            )
+        return responses

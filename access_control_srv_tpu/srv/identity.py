@@ -1,0 +1,36 @@
+"""Identity-service client interface.
+
+The reference resolves subject tokens through an external identity service
+(``findByToken`` over gRPC, reference: src/worker.ts:135-143,
+src/core/accessController.ts:110-117).  The engine only needs the
+``find_by_token`` call; deployments plug a transport-backed client, tests
+plug a static map (the mock-IDS pattern from
+test/microservice_acs_enabled.spec.ts:106-223).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Protocol
+
+
+class IdentityClient(Protocol):
+    def find_by_token(self, token: str) -> Optional[dict]:
+        """Returns ``{"payload": {"id", "tokens", "role_associations", ...}}``
+        or None."""
+        ...
+
+
+class StaticIdentityClient:
+    """Token -> subject payload map (test/mock implementation)."""
+
+    def __init__(self, subjects_by_token: dict[str, dict] | None = None):
+        self.subjects_by_token = subjects_by_token or {}
+
+    def register(self, token: str, payload: dict) -> None:
+        self.subjects_by_token[token] = payload
+
+    def find_by_token(self, token: str) -> Optional[dict]:
+        payload = self.subjects_by_token.get(token)
+        if payload is None:
+            return {"payload": None, "status": {"code": 404, "message": "not found"}}
+        return {"payload": payload, "status": {"code": 200, "message": "success"}}

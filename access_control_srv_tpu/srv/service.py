@@ -1,0 +1,150 @@
+"""The PDP service facade: isAllowed / whatIsAllowed endpoints.
+
+Framework analog of the reference's AccessControlService
+(reference: src/accessControlService.ts): deny-on-exception envelopes,
+wire-context unmarshalling (the gRPC layer carries context values as
+protobuf-Any-style ``{"value": <json bytes>}``), and policy loading in
+``local`` (YAML files) vs ``database`` (store) mode.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ..core.engine import AccessController
+from ..core.loader import load_policy_sets_from_file
+from ..models.model import (
+    Attribute,
+    Decision,
+    OperationStatus,
+    Request,
+    Response,
+    ReverseQuery,
+    Target,
+    coerce_target,
+)
+
+
+def unmarshall_any(value: Any) -> Any:
+    """protobuf-Any-ish -> JSON (reference: accessControlService.ts:103-125)."""
+    if isinstance(value, dict) and "value" in value and set(value) <= {
+        "type_url",
+        "value",
+    }:
+        raw = value["value"]
+        if isinstance(raw, (bytes, bytearray)):
+            raw = raw.decode()
+        return json.loads(raw)
+    return value
+
+
+def unmarshall_context(context: Optional[dict]) -> Optional[dict]:
+    if context is None:
+        return None
+    out = dict(context)
+    if "subject" in out and out["subject"] is not None:
+        out["subject"] = unmarshall_any(out["subject"])
+    if "resources" in out and out["resources"] is not None:
+        out["resources"] = [unmarshall_any(r) for r in out["resources"]]
+    if "security" in out and out["security"] is not None:
+        out["security"] = unmarshall_any(out["security"])
+    return out
+
+
+def coerce_request(request: Any) -> Request:
+    if isinstance(request, Request):
+        if isinstance(request.context, dict):
+            request.context = unmarshall_context(request.context)
+        return request
+    target = coerce_target(request.get("target"))
+    context = unmarshall_context(request.get("context"))
+    return Request(target=target, context=context)
+
+
+class AccessControlService:
+    def __init__(self, cfg, engine: AccessController, evaluator=None,
+                 store=None, logger=None):
+        self.cfg = cfg
+        self.engine = engine
+        self.evaluator = evaluator
+        self.store = store
+        self.logger = logger
+
+    # ------------------------------------------------------------- endpoints
+
+    def is_allowed(self, request: Any) -> Response:
+        """Deny-by-default on any evaluation exception
+        (reference: accessControlService.ts:62-81)."""
+        try:
+            req = coerce_request(request)
+            if self.evaluator is not None:
+                return self.evaluator.is_allowed(req)
+            return self.engine.is_allowed(req)
+        except Exception as err:
+            if self.logger:
+                self.logger.exception("isAllowed failed")
+            code = getattr(err, "code", 500)
+            return Response(
+                decision=Decision.DENY,
+                obligations=[],
+                evaluation_cacheable=False,
+                operation_status=OperationStatus(
+                    code=code if isinstance(code, int) else 500,
+                    message=str(err) or "Unknown Error!",
+                ),
+            )
+
+    def is_allowed_batch(self, requests: list) -> list[Response]:
+        try:
+            reqs = [coerce_request(r) for r in requests]
+        except Exception as err:
+            code = getattr(err, "code", 500)
+            status = OperationStatus(
+                code=code if isinstance(code, int) else 500, message=str(err)
+            )
+            return [
+                Response(decision=Decision.DENY, operation_status=status)
+                for _ in requests
+            ]
+        if self.evaluator is not None:
+            return self.evaluator.is_allowed_batch(reqs)
+        return [self.engine.is_allowed(r) for r in reqs]
+
+    def what_is_allowed(self, request: Any) -> ReverseQuery:
+        """(reference: accessControlService.ts:83-101)"""
+        try:
+            req = coerce_request(request)
+            return self.engine.what_is_allowed(req)
+        except Exception as err:
+            if self.logger:
+                self.logger.exception("whatIsAllowed failed")
+            code = getattr(err, "code", 500)
+            return ReverseQuery(
+                policy_sets=[],
+                obligations=[],
+                operation_status=OperationStatus(
+                    code=code if isinstance(code, int) else 500,
+                    message=str(err) or "Unknown Error!",
+                ),
+            )
+
+    # --------------------------------------------------------------- loading
+
+    def load_policies(self) -> None:
+        """local-YAML vs database policy source
+        (reference: accessControlService.ts:36-54)."""
+        policies_cfg = self.cfg.get("policies", {}) or {}
+        kind = policies_cfg.get("type", "local")
+        if kind == "local":
+            for path in policies_cfg.get("paths", []) or []:
+                for policy_set in load_policy_sets_from_file(path):
+                    self.engine.update_policy_set(policy_set)
+            if self.evaluator is not None:
+                self.evaluator.refresh()
+        elif kind == "database":
+            if self.store is None:
+                raise ValueError("database policy source requires a store")
+            self.store.load()
+        else:
+            raise ValueError(f"unknown policies.type {kind!r}")

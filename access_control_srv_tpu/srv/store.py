@@ -1,0 +1,354 @@
+"""Policy store: document collections + CRUD services + hot tree sync.
+
+Framework analog of the reference's resource layer
+(reference: src/resourceManager.ts): three CRUD services (rule / policy /
+policy_set) persisting flat documents (children referenced by id), each
+mutation stamping owner metadata, optionally self-authorizing through the
+engine, emitting a CRUD event, and hot-syncing the in-memory evaluation
+tree (+ kernel recompile via the evaluator).
+
+Persistence is pluggable: the default collection is in-memory with an
+optional JSON snapshot directory (the ArangoDB role is durability +
+queries; decision semantics never depended on it, SURVEY.md L6)."""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import threading
+import uuid
+from typing import Callable, Optional
+
+from ..core.engine import AccessController
+from ..core.loader import policy_from_dict, policy_set_from_dict, rule_from_dict
+from ..models.model import Decision
+
+
+class Collection:
+    """An ordered id -> document map with optional JSON snapshotting."""
+
+    def __init__(self, name: str, snapshot_dir: Optional[str] = None):
+        self.name = name
+        self._docs: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.snapshot_dir = snapshot_dir
+        if snapshot_dir:
+            path = os.path.join(snapshot_dir, f"{name}.json")
+            if os.path.exists(path):
+                with open(path) as fh:
+                    for doc in json.load(fh):
+                        self._docs[doc["id"]] = doc
+
+    def _snapshot(self):
+        if not self.snapshot_dir:
+            return
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        path = os.path.join(self.snapshot_dir, f"{self.name}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(list(self._docs.values()), fh, indent=1)
+        os.replace(tmp, path)
+
+    def upsert(self, doc: dict) -> None:
+        with self._lock:
+            self._docs[doc["id"]] = copy.deepcopy(doc)
+            self._snapshot()
+
+    def insert(self, doc: dict) -> bool:
+        with self._lock:
+            if doc["id"] in self._docs:
+                return False
+            self._docs[doc["id"]] = copy.deepcopy(doc)
+            self._snapshot()
+            return True
+
+    def get(self, doc_id: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._docs.get(doc_id)
+            return copy.deepcopy(doc) if doc is not None else None
+
+    def delete(self, doc_id: str) -> bool:
+        with self._lock:
+            existed = self._docs.pop(doc_id, None) is not None
+            self._snapshot()
+            return existed
+
+    def all(self) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(d) for d in self._docs.values()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._docs.clear()
+            self._snapshot()
+
+
+def _op_status(code=200, message="success"):
+    return {"code": code, "message": message}
+
+
+class ResourceService:
+    """Generic CRUD over one resource kind with metadata stamping,
+    self-authorization, event emission and tree hot-sync
+    (reference: RuleService/PolicyService/PolicySetService in
+    src/resourceManager.ts)."""
+
+    KIND_EVENT = {"rule": "rule", "policy": "policy", "policy_set": "policySet"}
+
+    def __init__(
+        self,
+        kind: str,
+        collection: Collection,
+        store: "PolicyStore",
+        topic=None,
+        access_check: Optional[Callable] = None,
+        urns=None,
+        logger=None,
+    ):
+        self.kind = kind
+        self.collection = collection
+        self.store = store
+        self.topic = topic
+        self.access_check = access_check
+        self.urns = urns
+        self.logger = logger
+
+    # -------------------------------------------------------------- helpers
+
+    def read_meta_data(self, doc_id: str) -> Optional[dict]:
+        doc = self.collection.get(doc_id)
+        return doc.get("meta") if doc else None
+
+    def _create_metadata(self, items: list[dict], action: str, subject) -> list[dict]:
+        """Owner stamping + id generation
+        (reference: src/core/utils.ts:269-349)."""
+        urns = self.urns
+        org_owner_attrs = []
+        scope = (subject or {}).get("scope")
+        if subject and scope and action in ("CREATE", "MODIFY"):
+            org_owner_attrs.append(
+                {
+                    "id": urns.get("ownerIndicatoryEntity"),
+                    "value": urns.get("organization"),
+                    "attributes": [
+                        {"id": urns.get("ownerInstance"), "value": scope}
+                    ],
+                }
+            )
+        for item in items:
+            meta = item.setdefault("meta", {})
+            if action in ("MODIFY", "DELETE"):
+                existing = self.read_meta_data(item.get("id", ""))
+                if existing and existing.get("owners"):
+                    meta["owners"] = existing["owners"]
+                    continue
+            if not item.get("id"):
+                item["id"] = uuid.uuid4().hex
+            owners = meta.get("owners") or list(org_owner_attrs)
+            if subject and subject.get("id"):
+                owners = owners + [
+                    {
+                        "id": urns.get("ownerIndicatoryEntity"),
+                        "value": urns.get("user"),
+                        "attributes": [
+                            {
+                                "id": urns.get("ownerInstance"),
+                                "value": subject["id"],
+                            }
+                        ],
+                    }
+                ]
+            meta["owners"] = owners
+        return items
+
+    def _authorize(self, items, action, subject, ctx) -> Optional[dict]:
+        """Self-authorization of CRUD through the engine
+        (reference: checkAccessRequest, src/core/utils.ts:212-261; every
+        CRUD op in resourceManager.ts)."""
+        if self.access_check is None:
+            return None
+        decision = self.access_check(self.kind, items, action, subject, ctx)
+        if decision != Decision.PERMIT:
+            return {
+                "operation_status": _op_status(
+                    403,
+                    f"Access not allowed for request with subject:"
+                    f"{(subject or {}).get('id')}, resource:{self.kind}, "
+                    f"action:{action}, target_scope:{(subject or {}).get('scope')}; "
+                    f"the response was {decision}",
+                )
+            }
+        return None
+
+    def _emit(self, event: str, doc: dict) -> None:
+        if self.topic is not None:
+            self.topic.emit(event, doc)
+
+    # ----------------------------------------------------------------- CRUD
+
+    def create(self, items: list[dict], subject=None, ctx=None) -> dict:
+        items = self._create_metadata([copy.deepcopy(i) for i in items], "CREATE", subject)
+        denied = self._authorize(items, "CREATE", subject, ctx)
+        if denied:
+            return denied
+        results = []
+        for doc in items:
+            self.collection.upsert(doc)
+            self._emit(f"{self.KIND_EVENT[self.kind]}Created", doc)
+            results.append({"payload": doc, "status": _op_status()})
+        self.store.sync_after_mutation(self.kind, "create", items)
+        return {"items": results, "operation_status": _op_status()}
+
+    def update(self, items: list[dict], subject=None, ctx=None) -> dict:
+        items = self._create_metadata([copy.deepcopy(i) for i in items], "MODIFY", subject)
+        denied = self._authorize(items, "MODIFY", subject, ctx)
+        if denied:
+            return denied
+        results = []
+        for doc in items:
+            if self.collection.get(doc["id"]) is None:
+                results.append(
+                    {"payload": None,
+                     "status": _op_status(404, f"{doc['id']} not found")}
+                )
+                continue
+            self.collection.upsert(doc)
+            self._emit(f"{self.KIND_EVENT[self.kind]}Modified", doc)
+            results.append({"payload": doc, "status": _op_status()})
+        self.store.sync_after_mutation(self.kind, "update", items)
+        return {"items": results, "operation_status": _op_status()}
+
+    def upsert(self, items: list[dict], subject=None, ctx=None) -> dict:
+        items = self._create_metadata([copy.deepcopy(i) for i in items], "MODIFY", subject)
+        denied = self._authorize(items, "MODIFY", subject, ctx)
+        if denied:
+            return denied
+        results = []
+        for doc in items:
+            self.collection.upsert(doc)
+            self._emit(f"{self.KIND_EVENT[self.kind]}Modified", doc)
+            results.append({"payload": doc, "status": _op_status()})
+        self.store.sync_after_mutation(self.kind, "upsert", items)
+        return {"items": results, "operation_status": _op_status()}
+
+    def super_upsert(self, items: list[dict]) -> dict:
+        """Seed-data path: no authorization (reference: src/worker.ts:228)."""
+        for doc in items:
+            self.collection.upsert(copy.deepcopy(doc))
+        self.store.sync_after_mutation(self.kind, "upsert", items)
+        return {"operation_status": _op_status()}
+
+    def read(self, filters: Optional[dict] = None) -> dict:
+        docs = self.collection.all()
+        if filters and "ids" in filters:
+            wanted = set(filters["ids"])
+            docs = [d for d in docs if d["id"] in wanted]
+        return {
+            "items": [{"payload": d, "status": _op_status()} for d in docs],
+            "operation_status": _op_status(),
+        }
+
+    def delete(self, ids=None, collection=False, subject=None, ctx=None) -> dict:
+        if collection:
+            denied = self._authorize([], "DROP", subject, ctx)
+            if denied:
+                return denied
+            self.collection.clear()
+            self._emit(f"{self.KIND_EVENT[self.kind]}Deleted", {"collection": True})
+            self.store.sync_after_mutation(self.kind, "delete_all", [])
+            return {"operation_status": _op_status()}
+        items = [{"id": i} for i in (ids or [])]
+        items = self._create_metadata(items, "DELETE", subject)
+        denied = self._authorize(items, "DELETE", subject, ctx)
+        if denied:
+            return denied
+        for doc_id in ids or []:
+            self.collection.delete(doc_id)
+            self._emit(f"{self.KIND_EVENT[self.kind]}Deleted", {"id": doc_id})
+        self.store.sync_after_mutation(self.kind, "delete", items)
+        return {"operation_status": _op_status()}
+
+
+class PolicyStore:
+    """The three collections + tree composition + hot sync
+    (reference: ResourceManager, src/resourceManager.ts:1050-1092; the
+    3-level load join :765-797)."""
+
+    def __init__(
+        self,
+        engine: AccessController,
+        evaluator=None,
+        bus=None,
+        snapshot_dir: Optional[str] = None,
+        access_check: Optional[Callable] = None,
+        logger=None,
+    ):
+        self.engine = engine
+        self.evaluator = evaluator
+        self.logger = logger
+        self.collections = {
+            kind: Collection(kind, snapshot_dir)
+            for kind in ("rule", "policy", "policy_set")
+        }
+        self.services = {
+            kind: ResourceService(
+                kind,
+                self.collections[kind],
+                self,
+                topic=bus.topic(f"io.restorecommerce.{kind}s.resource")
+                if bus
+                else None,
+                access_check=access_check,
+                urns=engine.urns,
+                logger=logger,
+            )
+            for kind in ("rule", "policy", "policy_set")
+        }
+
+    def get_resource_service(self, kind: str) -> ResourceService:
+        return self.services[kind]
+
+    def load(self) -> None:
+        """Compose the 3-level tree from the flat collections and swap it
+        into the engine (reference: PolicySetService.load)."""
+        rules = {d["id"]: rule_from_dict(d) for d in self.collections["rule"].all()}
+        policies = {}
+        for p_doc in self.collections["policy"].all():
+            child_rules = []
+            for rid in p_doc.get("rules") or []:
+                # missing children become None placeholders
+                child_rules.append(rules.get(rid))
+            policy = policy_from_dict(p_doc)
+            policy.combinables = {
+                (r.id if r is not None else f"__missing_{i}"): r
+                for i, r in enumerate(child_rules)
+            }
+            policies[p_doc["id"]] = policy
+        self.engine.clear_policies()
+        for ps_doc in self.collections["policy_set"].all():
+            child_policies = []
+            for pid in ps_doc.get("policies") or []:
+                child_policies.append(policies.get(pid))
+            policy_set = policy_set_from_dict(ps_doc)
+            policy_set.combinables = {
+                (p.id if p is not None else f"__missing_{i}"): p
+                for i, p in enumerate(child_policies)
+            }
+            self.engine.update_policy_set(policy_set)
+        if self.evaluator is not None:
+            self.evaluator.refresh()
+
+    def sync_after_mutation(self, kind: str, op: str, items: list[dict]) -> None:
+        """Hot-sync the in-memory tree after a CRUD mutation.  The
+        reference does targeted Map surgery for creates/deletes and a full
+        reload for updates/upserts (reference: resourceManager.ts:202-215,
+        274, 305, 352-369); a full recompose keeps both paths consistent
+        here, then the evaluator recompiles."""
+        self.load()
+
+    def seed(self, policy_set_docs, policy_docs, rule_docs) -> None:
+        """superUpsert seed loading (reference: src/worker.ts:200-242)."""
+        self.services["rule"].super_upsert(rule_docs)
+        self.services["policy"].super_upsert(policy_docs)
+        self.services["policy_set"].super_upsert(policy_set_docs)

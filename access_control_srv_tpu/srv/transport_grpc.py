@@ -1,0 +1,569 @@
+"""gRPC transport: protobuf services over the worker.
+
+Exposes the same five-service surface as the reference
+(reference: src/worker.ts:161-194 binds access-control, rule / policy /
+policy_set CRUD, command interface and health): protobuf messages are
+compiled from proto/access_control.proto; service registration uses
+generic method handlers (this image ships protoc but not the gRPC python
+stub generator).  ``IsAllowedBatch`` is the framework extension feeding
+the batched TPU evaluation path directly.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..models.model import (
+    Attribute,
+    ContextQuery,
+    Decision,
+    Request,
+    Response,
+    ReverseQuery,
+    Target,
+)
+from .gen import access_control_pb2 as pb
+
+DECISION_TO_PB = {
+    Decision.PERMIT: pb.PERMIT,
+    Decision.DENY: pb.DENY,
+    Decision.INDETERMINATE: pb.INDETERMINATE,
+}
+PB_TO_DECISION = {v: k for k, v in DECISION_TO_PB.items()}
+
+
+# ------------------------------------------------------------- converters
+
+def attr_to_pb(attr: Attribute) -> pb.Attribute:
+    return pb.Attribute(
+        id=attr.id or "",
+        value=attr.value or "",
+        attributes=[attr_to_pb(a) for a in attr.attributes or []],
+    )
+
+
+def attr_from_pb(msg: pb.Attribute) -> Attribute:
+    return Attribute(
+        id=msg.id,
+        value=msg.value,
+        attributes=[attr_from_pb(a) for a in msg.attributes],
+    )
+
+
+def target_to_pb(target: Optional[Target]) -> Optional[pb.Target]:
+    if target is None:
+        return None
+    return pb.Target(
+        subjects=[attr_to_pb(a) for a in target.subjects],
+        resources=[attr_to_pb(a) for a in target.resources],
+        actions=[attr_to_pb(a) for a in target.actions],
+    )
+
+
+def target_from_pb(msg: Optional[pb.Target]) -> Optional[Target]:
+    if msg is None:
+        return None
+    return Target(
+        subjects=[attr_from_pb(a) for a in msg.subjects],
+        resources=[attr_from_pb(a) for a in msg.resources],
+        actions=[attr_from_pb(a) for a in msg.actions],
+    )
+
+
+def _ctx_value_from_pb(msg: pb.ContextValue):
+    if not msg.value:
+        return None
+    return {"type_url": msg.type_url, "value": bytes(msg.value)}
+
+
+def request_from_pb(msg: pb.Request) -> Request:
+    context = None
+    if msg.HasField("context"):
+        context = {}
+        if msg.context.HasField("subject"):
+            context["subject"] = _ctx_value_from_pb(msg.context.subject)
+        context["resources"] = [
+            _ctx_value_from_pb(r) for r in msg.context.resources
+        ]
+        if msg.context.HasField("security"):
+            context["security"] = _ctx_value_from_pb(msg.context.security)
+    target = target_from_pb(msg.target) if msg.HasField("target") else None
+    return Request(target=target, context=context)
+
+
+def request_to_pb(request: Request) -> pb.Request:
+    msg = pb.Request()
+    if request.target is not None:
+        msg.target.CopyFrom(target_to_pb(request.target))
+    context = request.context
+    if context is not None:
+        subject = context.get("subject")
+        if subject is not None:
+            msg.context.subject.value = json.dumps(subject).encode()
+        for res in context.get("resources") or []:
+            entry = msg.context.resources.add()
+            entry.value = json.dumps(res).encode()
+        security = context.get("security")
+        if security is not None:
+            msg.context.security.value = json.dumps(security).encode()
+    return msg
+
+
+def response_to_pb(response: Response) -> pb.Response:
+    return pb.Response(
+        decision=DECISION_TO_PB.get(response.decision, pb.INDETERMINATE),
+        obligations=[attr_to_pb(a) for a in response.obligations or []],
+        evaluation_cacheable=bool(response.evaluation_cacheable),
+        operation_status=pb.OperationStatus(
+            code=response.operation_status.code,
+            message=response.operation_status.message,
+        ),
+    )
+
+
+def reverse_query_to_pb(rq: ReverseQuery) -> pb.ReverseQuery:
+    out = pb.ReverseQuery(
+        obligations=[attr_to_pb(a) for a in rq.obligations or []],
+        operation_status=pb.OperationStatus(
+            code=rq.operation_status.code, message=rq.operation_status.message
+        ),
+    )
+    for ps in rq.policy_sets:
+        ps_msg = out.policy_sets.add(
+            id=ps.id or "",
+            effect=ps.effect or "",
+            combining_algorithm=ps.combining_algorithm or "",
+        )
+        if ps.target is not None:
+            ps_msg.target.CopyFrom(target_to_pb(ps.target))
+        for pol in ps.policies:
+            p_msg = ps_msg.policies.add(
+                id=pol.id or "",
+                effect=pol.effect or "",
+                combining_algorithm=pol.combining_algorithm or "",
+                evaluation_cacheable=bool(pol.evaluation_cacheable),
+                has_rules=bool(pol.has_rules),
+            )
+            if pol.target is not None:
+                p_msg.target.CopyFrom(target_to_pb(pol.target))
+            for rule in pol.rules:
+                r_msg = p_msg.rules.add(
+                    id=rule.id or "",
+                    effect=rule.effect or "",
+                    condition=rule.condition or "",
+                    evaluation_cacheable=bool(rule.evaluation_cacheable),
+                )
+                if rule.target is not None:
+                    r_msg.target.CopyFrom(target_to_pb(rule.target))
+                if rule.context_query is not None:
+                    r_msg.context_query.query = rule.context_query.query or ""
+                    for f in rule.context_query.filters or []:
+                        r_msg.context_query.filters.add(
+                            field=str(f.get("field") or ""),
+                            operation=str(f.get("operation") or ""),
+                            value=str(f.get("value") or ""),
+                        )
+    return out
+
+
+def _meta_to_dict(msg: pb.Meta) -> dict:
+    return {
+        "owners": [_attr_dict(a) for a in msg.owners],
+        "acls": [_attr_dict(a) for a in msg.acls],
+    }
+
+
+def _attr_dict(msg: pb.Attribute) -> dict:
+    return {
+        "id": msg.id,
+        "value": msg.value,
+        "attributes": [_attr_dict(a) for a in msg.attributes],
+    }
+
+
+def _target_dict(msg: pb.Target) -> dict:
+    return {
+        "subjects": [_attr_dict(a) for a in msg.subjects],
+        "resources": [_attr_dict(a) for a in msg.resources],
+        "actions": [_attr_dict(a) for a in msg.actions],
+    }
+
+
+def rule_doc_from_pb(msg: pb.Rule) -> dict:
+    doc = {
+        "id": msg.id,
+        "name": msg.name,
+        "description": msg.description,
+        "effect": msg.effect or None,
+        "condition": msg.condition,
+        "evaluation_cacheable": msg.evaluation_cacheable,
+    }
+    if msg.HasField("target"):
+        doc["target"] = _target_dict(msg.target)
+    if msg.HasField("context_query"):
+        doc["context_query"] = {
+            "query": msg.context_query.query,
+            "filters": [
+                {"field": f.field, "operation": f.operation, "value": f.value}
+                for f in msg.context_query.filters
+            ],
+        }
+    if msg.HasField("meta"):
+        doc["meta"] = _meta_to_dict(msg.meta)
+    return doc
+
+
+def policy_doc_from_pb(msg: pb.Policy) -> dict:
+    doc = {
+        "id": msg.id,
+        "name": msg.name,
+        "description": msg.description,
+        "effect": msg.effect or None,
+        "combining_algorithm": msg.combining_algorithm,
+        "rules": list(msg.rules),
+        "evaluation_cacheable": msg.evaluation_cacheable,
+    }
+    if msg.HasField("target"):
+        doc["target"] = _target_dict(msg.target)
+    if msg.HasField("meta"):
+        doc["meta"] = _meta_to_dict(msg.meta)
+    return doc
+
+
+def policy_set_doc_from_pb(msg: pb.PolicySet) -> dict:
+    doc = {
+        "id": msg.id,
+        "name": msg.name,
+        "description": msg.description,
+        "combining_algorithm": msg.combining_algorithm,
+        "policies": list(msg.policies),
+    }
+    if msg.HasField("target"):
+        doc["target"] = _target_dict(msg.target)
+    if msg.HasField("meta"):
+        doc["meta"] = _meta_to_dict(msg.meta)
+    return doc
+
+
+def _subject_from_pb(msg: pb.Subject) -> Optional[dict]:
+    if not (msg.id or msg.token or msg.scope or msg.data):
+        return None
+    subject = {"id": msg.id or None, "token": msg.token or None,
+               "scope": msg.scope or None}
+    if msg.data:
+        subject.update(json.loads(msg.data))
+    return subject
+
+
+# ----------------------------------------------------------------- server
+
+def _unary(handler, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+class GrpcServer:
+    """Binds the worker's services to a grpc.Server."""
+
+    def __init__(self, worker, addr: str = "127.0.0.1:0", max_workers: int = 16):
+        self.worker = worker
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._register()
+        self.port = self.server.add_insecure_port(addr)
+        self.addr = addr.rsplit(":", 1)[0] + f":{self.port}"
+
+    def start(self):
+        self.server.start()
+        return self
+
+    def stop(self, grace: float = 0.5):
+        self.server.stop(grace)
+
+    # ------------------------------------------------------------- handlers
+
+    def _register(self):
+        worker = self.worker
+
+        def is_allowed(request, context):
+            response = worker.service.is_allowed(request_from_pb(request))
+            return response_to_pb(response)
+
+        def is_allowed_batch(request, context):
+            responses = worker.service.is_allowed_batch(
+                [request_from_pb(r) for r in request.requests]
+            )
+            return pb.BatchResponse(
+                responses=[response_to_pb(r) for r in responses]
+            )
+
+        def what_is_allowed(request, context):
+            rq = worker.service.what_is_allowed(request_from_pb(request))
+            return reverse_query_to_pb(rq)
+
+        ac_handlers = {
+            "IsAllowed": _unary(is_allowed, pb.Request, pb.Response),
+            "IsAllowedBatch": _unary(
+                is_allowed_batch, pb.BatchRequest, pb.BatchResponse
+            ),
+            "WhatIsAllowed": _unary(what_is_allowed, pb.Request, pb.ReverseQuery),
+        }
+        self.server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "acstpu.AccessControlService", ac_handlers
+                ),
+            )
+        )
+
+        for kind, doc_from, list_cls, list_resp_cls, fill in (
+            ("rule", rule_doc_from_pb, pb.RuleList, pb.RuleListResponse,
+             self._fill_rule),
+            ("policy", policy_doc_from_pb, pb.PolicyList,
+             pb.PolicyListResponse, self._fill_policy),
+            ("policy_set", policy_set_doc_from_pb, pb.PolicySetList,
+             pb.PolicySetListResponse, self._fill_policy_set),
+        ):
+            handlers = self._crud_handlers(kind, doc_from, list_cls,
+                                           list_resp_cls, fill)
+            name = {
+                "rule": "acstpu.RuleService",
+                "policy": "acstpu.PolicyService",
+                "policy_set": "acstpu.PolicySetService",
+            }[kind]
+            self.server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(name, handlers),)
+            )
+
+        def command(request, context):
+            payload = json.loads(request.payload) if request.payload else {}
+            result = worker.command_interface.command(request.name, payload)
+            return pb.CommandResponse(payload=json.dumps(result).encode())
+
+        self.server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "acstpu.CommandInterface",
+                    {"Command": _unary(command, pb.CommandRequest,
+                                       pb.CommandResponse)},
+                ),
+            )
+        )
+
+        def health(request, context):
+            result = worker.command_interface.command("health_check")
+            return pb.HealthCheckResponse(status=result["status"])
+
+        self.server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    "acstpu.Health",
+                    {"Check": _unary(health, pb.HealthCheckRequest,
+                                     pb.HealthCheckResponse)},
+                ),
+            )
+        )
+
+    def _crud_handlers(self, kind, doc_from_pb, list_cls, list_resp_cls, fill):
+        service = self.worker.store.get_resource_service(kind)
+
+        def create(request, context):
+            return self._mutation_response(
+                service.create([doc_from_pb(i) for i in request.items],
+                               subject=_subject_from_pb(request.subject))
+            )
+
+        def update(request, context):
+            return self._mutation_response(
+                service.update([doc_from_pb(i) for i in request.items],
+                               subject=_subject_from_pb(request.subject))
+            )
+
+        def upsert(request, context):
+            return self._mutation_response(
+                service.upsert([doc_from_pb(i) for i in request.items],
+                               subject=_subject_from_pb(request.subject))
+            )
+
+        def delete(request, context):
+            return self._mutation_response(
+                service.delete(ids=list(request.ids),
+                               collection=request.collection,
+                               subject=_subject_from_pb(request.subject))
+            )
+
+        def read(request, context):
+            result = service.read(
+                {"ids": list(request.ids)} if request.ids else None
+            )
+            resp = list_resp_cls()
+            for item in result.get("items", []):
+                payload = item.get("payload")
+                if payload is not None:
+                    fill(resp.items.add(), payload)
+            status = result["operation_status"]
+            resp.operation_status.code = status["code"]
+            resp.operation_status.message = status["message"]
+            return resp
+
+        return {
+            "Create": _unary(create, list_cls, pb.MutationResponse),
+            "Update": _unary(update, list_cls, pb.MutationResponse),
+            "Upsert": _unary(upsert, list_cls, pb.MutationResponse),
+            "Delete": _unary(delete, pb.DeleteRequest, pb.MutationResponse),
+            "Read": _unary(read, pb.ReadRequest, list_resp_cls),
+        }
+
+    # ---------------------------------------------------- doc -> pb fillers
+
+    @staticmethod
+    def _fill_attr(msg: pb.Attribute, doc: dict):
+        msg.id = doc.get("id") or ""
+        msg.value = str(doc.get("value") or "")
+        for child in doc.get("attributes") or []:
+            GrpcServer._fill_attr(msg.attributes.add(), child)
+
+    @staticmethod
+    def _fill_target(msg: pb.Target, doc: dict):
+        for key, field in (("subjects", msg.subjects),
+                           ("resources", msg.resources),
+                           ("actions", msg.actions)):
+            for attr in doc.get(key) or []:
+                GrpcServer._fill_attr(field.add(), attr)
+
+    @staticmethod
+    def _fill_meta(msg: pb.Meta, doc: dict):
+        for owner in doc.get("owners") or []:
+            GrpcServer._fill_attr(msg.owners.add(), owner)
+        for acl in doc.get("acls") or []:
+            GrpcServer._fill_attr(msg.acls.add(), acl)
+
+    @classmethod
+    def _fill_rule(cls, msg: pb.Rule, doc: dict):
+        msg.id = doc.get("id") or ""
+        msg.name = doc.get("name") or ""
+        msg.description = doc.get("description") or ""
+        msg.effect = doc.get("effect") or ""
+        msg.condition = doc.get("condition") or ""
+        msg.evaluation_cacheable = bool(doc.get("evaluation_cacheable"))
+        if doc.get("target"):
+            cls._fill_target(msg.target, doc["target"])
+        if doc.get("context_query"):
+            cq = doc["context_query"]
+            msg.context_query.query = cq.get("query") or ""
+            for f in cq.get("filters") or []:
+                msg.context_query.filters.add(
+                    field=str(f.get("field") or ""),
+                    operation=str(f.get("operation") or ""),
+                    value=str(f.get("value") or ""),
+                )
+        if doc.get("meta"):
+            cls._fill_meta(msg.meta, doc["meta"])
+
+    @classmethod
+    def _fill_policy(cls, msg: pb.Policy, doc: dict):
+        msg.id = doc.get("id") or ""
+        msg.name = doc.get("name") or ""
+        msg.description = doc.get("description") or ""
+        msg.effect = doc.get("effect") or ""
+        msg.combining_algorithm = doc.get("combining_algorithm") or ""
+        msg.evaluation_cacheable = bool(doc.get("evaluation_cacheable"))
+        msg.rules.extend(doc.get("rules") or [])
+        if doc.get("target"):
+            cls._fill_target(msg.target, doc["target"])
+        if doc.get("meta"):
+            cls._fill_meta(msg.meta, doc["meta"])
+
+    @classmethod
+    def _fill_policy_set(cls, msg: pb.PolicySet, doc: dict):
+        msg.id = doc.get("id") or ""
+        msg.name = doc.get("name") or ""
+        msg.description = doc.get("description") or ""
+        msg.combining_algorithm = doc.get("combining_algorithm") or ""
+        msg.policies.extend(doc.get("policies") or [])
+        if doc.get("target"):
+            cls._fill_target(msg.target, doc["target"])
+        if doc.get("meta"):
+            cls._fill_meta(msg.meta, doc["meta"])
+
+    @staticmethod
+    def _mutation_response(result: dict) -> pb.MutationResponse:
+        resp = pb.MutationResponse()
+        for item in result.get("items", []):
+            status = item.get("status", {})
+            payload = item.get("payload") or {}
+            resp.statuses.add(
+                id=payload.get("id", ""),
+                code=status.get("code", 200),
+                message=status.get("message", "success"),
+            )
+        op = result.get("operation_status", {})
+        resp.operation_status.code = op.get("code", 200)
+        resp.operation_status.message = op.get("message", "success")
+        return resp
+
+
+# ----------------------------------------------------------------- client
+
+class GrpcClient:
+    """Typed client over the generic channel (test + SDK use)."""
+
+    def __init__(self, addr: str):
+        self.channel = grpc.insecure_channel(addr)
+
+    def close(self):
+        self.channel.close()
+
+    def _call(self, service: str, method: str, request, resp_cls):
+        fn = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return fn(request)
+
+    def is_allowed(self, request: pb.Request) -> pb.Response:
+        return self._call("acstpu.AccessControlService", "IsAllowed",
+                          request, pb.Response)
+
+    def is_allowed_batch(self, request: pb.BatchRequest) -> pb.BatchResponse:
+        return self._call("acstpu.AccessControlService", "IsAllowedBatch",
+                          request, pb.BatchResponse)
+
+    def what_is_allowed(self, request: pb.Request) -> pb.ReverseQuery:
+        return self._call("acstpu.AccessControlService", "WhatIsAllowed",
+                          request, pb.ReverseQuery)
+
+    def crud(self, kind: str, method: str, request, resp_cls=None):
+        service = {
+            "rule": "acstpu.RuleService",
+            "policy": "acstpu.PolicyService",
+            "policy_set": "acstpu.PolicySetService",
+        }[kind]
+        if resp_cls is None:
+            resp_cls = pb.MutationResponse
+        return self._call(service, method, request, resp_cls)
+
+    def command(self, name: str, payload: dict | None = None) -> dict:
+        resp = self._call(
+            "acstpu.CommandInterface",
+            "Command",
+            pb.CommandRequest(
+                name=name, payload=json.dumps(payload or {}).encode()
+            ),
+            pb.CommandResponse,
+        )
+        return json.loads(resp.payload)
+
+    def health(self) -> str:
+        resp = self._call("acstpu.Health", "Check", pb.HealthCheckRequest(),
+                          pb.HealthCheckResponse)
+        return resp.status

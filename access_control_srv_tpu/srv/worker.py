@@ -1,0 +1,247 @@
+"""Composition root: wires the engine, evaluator, store, cache, command
+interface and event listeners into a running service
+(reference: src/worker.ts Worker.start/stop:105-372).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from ..core.engine import AccessController
+from ..core.loader import load_policy_sets_from_file
+from ..models.model import Decision
+from ..models.urns import Urns
+from .batcher import MicroBatcher
+from .cache import HRScopeProvider, SubjectCache, compare_role_associations
+from .command import CommandInterface
+from .config import Config
+from .evaluator import HybridEvaluator
+from .events import EventBus, OffsetStore
+from .identity import StaticIdentityClient
+from .service import AccessControlService
+from .store import PolicyStore
+
+
+def _yaml_list(path: str) -> list[dict]:
+    import yaml
+
+    with open(path) as fh:
+        docs = list(yaml.safe_load_all(fh))
+    items: list[dict] = []
+    for doc in docs:
+        if isinstance(doc, list):
+            items.extend(doc)
+        elif doc:
+            items.append(doc)
+    return items
+
+
+class Worker:
+    def __init__(self):
+        self.cfg: Optional[Config] = None
+        self.engine: Optional[AccessController] = None
+        self.evaluator: Optional[HybridEvaluator] = None
+        self.store: Optional[PolicyStore] = None
+        self.service: Optional[AccessControlService] = None
+        self.command_interface: Optional[CommandInterface] = None
+        self.batcher: Optional[MicroBatcher] = None
+        self.bus: Optional[EventBus] = None
+        self.subject_cache: Optional[SubjectCache] = None
+        self.hr_provider: Optional[HRScopeProvider] = None
+        self.identity_client = None
+        self.offset_store: Optional[OffsetStore] = None
+        self.logger = None
+
+    def start(
+        self,
+        cfg: Config | dict | None = None,
+        logger=None,
+        identity_client=None,
+    ) -> "Worker":
+        self.cfg = cfg if isinstance(cfg, Config) else Config(cfg or {})
+        cfg = self.cfg
+        self.logger = logger or logging.getLogger("access-control-srv-tpu")
+
+        # event bus + offsets (Kafka + OffsetStore analog)
+        self.bus = EventBus()
+        self.offset_store = OffsetStore()
+
+        # subject cache + HR-scope rendezvous (Redis + Kafka protocol analog)
+        self.subject_cache = SubjectCache()
+        auth_topic = self.bus.topic("io.restorecommerce.authentication")
+        self.hr_provider = HRScopeProvider(
+            self.subject_cache,
+            auth_topic,
+            timeout_ms=cfg.get("authorization:hrReqTimeout", 300_000),
+            logger=self.logger,
+        )
+
+        # identity client (external identity-srv analog)
+        self.identity_client = identity_client or StaticIdentityClient()
+
+        # the engine + evaluator
+        urns = Urns(cfg.get("policies:options:urns") or {})
+        combining = cfg.get("policies:options:combiningAlgorithms") or None
+        self.engine = AccessController(
+            urns=urns,
+            combining_algorithms=combining,
+            logger=self.logger,
+            identity_client=self.identity_client,
+            hr_scope_provider=self.hr_provider,
+        )
+        adapter_cfg = cfg.get("adapter") or {}
+        if adapter_cfg.get("graphql"):
+            self.engine.create_resource_adapter(adapter_cfg)
+        self.evaluator = HybridEvaluator(
+            self.engine,
+            backend=cfg.get("evaluator:backend", "hybrid"),
+            logger=self.logger,
+            async_compile=bool(cfg.get("evaluator:async_compile", False)),
+        )
+
+        # policy store with self-authorization hook
+        self.store = PolicyStore(
+            self.engine,
+            evaluator=self.evaluator,
+            bus=self.bus,
+            snapshot_dir=cfg.get("database:snapshot_dir"),
+            access_check=self._access_check
+            if cfg.get("authorization:enabled")
+            else None,
+            logger=self.logger,
+        )
+
+        # service facade + command interface + micro-batcher
+        self.service = AccessControlService(
+            cfg, self.engine, self.evaluator, self.store, self.logger
+        )
+        self.command_interface = CommandInterface(
+            cfg,
+            self.service,
+            store=self.store,
+            bus=self.bus,
+            cache=self.subject_cache,
+            logger=self.logger,
+        )
+        self.batcher = MicroBatcher(
+            self.evaluator,
+            window_ms=cfg.get("evaluator:micro_batch_window_ms", 2),
+            max_batch=cfg.get("evaluator:micro_batch_max", 4096),
+        )
+        self.batcher.start()
+
+        # event listeners (reference: src/worker.ts:249-361)
+        auth_topic.on(self._auth_listener)
+        self.bus.topic("io.restorecommerce.users.resource").on(
+            self._user_listener
+        )
+
+        # seed data (reference: src/worker.ts:200-242)
+        seed_cfg = cfg.get("seed_data")
+        if seed_cfg:
+            entities = seed_cfg.get("entities", seed_cfg) if isinstance(
+                seed_cfg, dict
+            ) else seed_cfg
+            self.store.seed(
+                _yaml_list(entities["policy_sets"]),
+                _yaml_list(entities["policies"]),
+                _yaml_list(entities["rules"]),
+            )
+
+        # policy load (reference: src/worker.ts:245)
+        self.service.load_policies()
+        return self
+
+    def stop(self) -> None:
+        if self.batcher is not None:
+            self.batcher.stop()
+
+    # -------------------------------------------------------- event handlers
+
+    def _auth_listener(self, event_name: str, message, ctx: dict) -> None:
+        """hierarchicalScopesResponse -> cache write + waiter release
+        (reference: src/worker.ts:252-299)."""
+        if event_name == "hierarchicalScopesResponse":
+            self.hr_provider.handle_hr_scopes_response(
+                message, subject_resolver=self.identity_client.find_by_token
+            )
+
+    def _user_listener(self, event_name: str, message, ctx: dict) -> None:
+        """userModified / userDeleted -> subject-cache eviction
+        (reference: src/worker.ts:300-345)."""
+        if event_name == "userDeleted":
+            user_id = (message or {}).get("id")
+            if user_id:
+                self.hr_provider.evict_hr_scopes(user_id)
+        elif event_name == "userModified":
+            user_id = (message or {}).get("id")
+            if not user_id:
+                return
+            cached = self.subject_cache.get(f"cache:{user_id}:subject")
+            if cached is None:
+                return
+            changed = compare_role_associations(
+                (message or {}).get("role_associations") or [],
+                cached.get("role_associations") or [],
+                self.logger,
+            )
+            if changed:
+                self.hr_provider.evict_hr_scopes(user_id)
+                self.bus.topic("io.restorecommerce.command").emit(
+                    "flushCacheCommand",
+                    {
+                        "name": "flush_cache",
+                        "payload": {
+                            "data": {"db_index": 5, "pattern": user_id}
+                        },
+                    },
+                )
+
+    # ------------------------------------------------- CRUD self-authorization
+
+    def _access_check(self, kind, items, action, subject, ctx):
+        """The service authorizes its own policy CRUD by asking itself
+        (reference: checkAccessRequest -> gRPC back into this service's
+        isAllowed, src/core/utils.ts:212-261, cfg client.acs-srv = self)."""
+        from ..models.model import Attribute, Request, Target
+
+        urns = self.engine.urns
+        action_urn = {
+            "CREATE": urns.get("create"),
+            "MODIFY": urns.get("modify"),
+            "DELETE": urns.get("delete"),
+            "DROP": urns.get("delete"),
+            "READ": urns.get("read"),
+        }.get(action, urns.get("read"))
+        entity = f"urn:restorecommerce:acs:model:{kind}.{kind.title().replace('_', '')}"
+        resources = []
+        ctx_resources = []
+        for item in items or [{}]:
+            resources.append(Attribute(id=urns.get("entity"), value=entity))
+            if item.get("id"):
+                resources.append(
+                    Attribute(id=urns.get("resourceID"), value=item["id"])
+                )
+                ctx_resources.append(
+                    {"id": item["id"], "meta": item.get("meta") or {}}
+                )
+        subjects = []
+        if subject:
+            token = subject.get("token")
+            if token:
+                subjects.append(Attribute(id="token", value=token))
+        request = Request(
+            target=Target(
+                subjects=subjects,
+                resources=resources,
+                actions=[Attribute(id=urns.get("actionID"), value=action_urn)],
+            ),
+            context={
+                "subject": dict(subject or {}),
+                "resources": ctx_resources,
+            },
+        )
+        response = self.service.is_allowed(request)
+        return response.decision

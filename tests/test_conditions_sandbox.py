@@ -1,0 +1,77 @@
+"""Condition-sandbox hardening tests: escapes must raise (-> deny-by-default
+at the engine), legitimate conditions must evaluate, runaway conditions must
+hit the execution budget."""
+
+import time
+
+import pytest
+
+from access_control_srv_tpu.core.conditions import (
+    ConditionBudgetExceeded,
+    ConditionValidationError,
+    condition_matches,
+)
+from access_control_srv_tpu.models import Request, Target
+
+REQ = Request(
+    target=Target(),
+    context={"subject": {"id": "ada"}, "resources": [{"id": "ada"}]},
+)
+
+ESCAPES = [
+    "__import__('os').system('true')",
+    "open('/etc/passwd').read()",
+    "[c for c in ().__class__.__base__.__subclasses__()][0]",
+    "getattr(context, '_obj')",
+    "(lambda: __builtins__)()",
+    'bool(re.enum.sys.modules["os"].system("true"))',
+    'len("{0.__class__.__init__.__globals__}".format(request)) > 0',
+    '"{x}".format_map(context)',
+    "import os",
+    "exec('1')",
+    "type(request)",
+]
+
+
+@pytest.mark.parametrize("condition", ESCAPES)
+def test_escape_blocked(condition):
+    with pytest.raises(Exception) as err:
+        condition_matches(condition, REQ)
+    assert isinstance(
+        err.value, (ConditionValidationError, SyntaxError, AttributeError,
+                    NameError, TypeError)
+    ), err.value
+
+
+@pytest.mark.parametrize(
+    "condition",
+    [
+        "def check(request, target, context):\n    while True:\n        pass",
+        "sum(1 for i in range(10**12)) > 0",
+        "all(True for a in range(10**9) for b in range(10**9))",
+    ],
+)
+def test_runaway_budget(condition):
+    t0 = time.time()
+    with pytest.raises(ConditionBudgetExceeded):
+        condition_matches(condition, REQ)
+    assert time.time() - t0 < 5
+
+
+@pytest.mark.parametrize(
+    "condition,expected",
+    [
+        ("any(r.id == context.subject.id for r in context.resources)", True),
+        ("context.subject.id == 'ben'", False),
+        ("re.search('ad', context.subject.id)", True),
+        ("len(context.resources) == 1", True),
+        (
+            "def check(request, target, context):\n"
+            "    return context.subject.id == 'ada'",
+            True,
+        ),
+        ("lambda request, target, context: True", True),
+    ],
+)
+def test_legitimate_conditions(condition, expected):
+    assert condition_matches(condition, REQ) is expected

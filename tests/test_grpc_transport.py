@@ -1,0 +1,124 @@
+"""End-to-end gRPC transport tests: a real grpc server + channel, protobuf
+wire messages, the full five-service surface."""
+
+import json
+import os
+
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+from .utils import URNS
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+SEED = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "data", "seed_data")
+
+
+@pytest.fixture(scope="module")
+def rig():
+    worker = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+        }
+    )
+    server = GrpcServer(worker, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    yield worker, client
+    client.close()
+    server.stop()
+    worker.stop()
+
+
+def wire_request(role="superadministrator-r-id", action=None):
+    action = action or URNS["read"]
+    msg = pb.Request()
+    msg.target.subjects.add(id=URNS["role"], value=role)
+    msg.target.subjects.add(id=URNS["subjectID"], value="root")
+    msg.target.resources.add(id=URNS["entity"], value=ORG)
+    msg.target.resources.add(id=URNS["resourceID"], value="O1")
+    msg.target.actions.add(id=URNS["actionID"], value=action)
+    msg.context.subject.value = json.dumps(
+        {
+            "id": "root",
+            "role_associations": [{"role": role, "attributes": []}],
+            "hierarchical_scopes": [],
+        }
+    ).encode()
+    entry = msg.context.resources.add()
+    entry.value = json.dumps({"id": "O1", "meta": {"owners": []}}).encode()
+    return msg
+
+
+def test_is_allowed_over_wire(rig):
+    _, client = rig
+    response = client.is_allowed(wire_request())
+    assert response.decision == pb.PERMIT
+    assert response.operation_status.code == 200
+    response = client.is_allowed(wire_request(role="nobody"))
+    assert response.decision == pb.INDETERMINATE
+
+
+def test_batch_over_wire(rig):
+    _, client = rig
+    batch = pb.BatchRequest(
+        requests=[wire_request() for _ in range(8)]
+        + [wire_request(role="nobody") for _ in range(8)]
+    )
+    response = client.is_allowed_batch(batch)
+    decisions = [r.decision for r in response.responses]
+    assert decisions[:8] == [pb.PERMIT] * 8
+    assert decisions[8:] == [pb.INDETERMINATE] * 8
+
+
+def test_what_is_allowed_over_wire(rig):
+    _, client = rig
+    rq = client.what_is_allowed(wire_request())
+    assert rq.operation_status.code == 200
+    assert len(rq.policy_sets) == 1
+    assert rq.policy_sets[0].id == "global_policy_set"
+    assert rq.policy_sets[0].policies[0].rules[0].id == "super_admin_rule"
+
+
+def test_crud_over_wire(rig):
+    worker, client = rig
+    rule = pb.Rule(id="r_wire", effect="PERMIT")
+    rule.target.subjects.add(id=URNS["role"], value="wire-role")
+    result = client.crud("rule", "Create", pb.RuleList(items=[rule]))
+    assert result.operation_status.code == 200
+
+    policy = pb.Policy(id="p_wire", combining_algorithm=PO, rules=["r_wire"])
+    client.crud("policy", "Create", pb.PolicyList(items=[policy]))
+    pset = pb.PolicySet(id="ps_wire", combining_algorithm=PO,
+                        policies=["p_wire"])
+    client.crud("policy_set", "Create", pb.PolicySetList(items=[pset]))
+
+    # hot-synced decision over the wire
+    response = client.is_allowed(wire_request(role="wire-role"))
+    assert response.decision == pb.PERMIT
+
+    # read back
+    read = client.crud("rule", "Read", pb.ReadRequest(ids=["r_wire"]),
+                       pb.RuleListResponse)
+    assert read.items[0].id == "r_wire"
+    assert read.items[0].target.subjects[0].value == "wire-role"
+
+    # delete flips the decision back
+    client.crud("rule", "Delete", pb.DeleteRequest(ids=["r_wire"]))
+    response = client.is_allowed(wire_request(role="wire-role"))
+    assert response.decision == pb.INDETERMINATE
+
+
+def test_command_and_health_over_wire(rig):
+    _, client = rig
+    assert client.health() == "SERVING"
+    version = client.command("version")
+    assert version["version"]

@@ -1,0 +1,414 @@
+"""Serving-shell integration tests: worker boot, CRUD round-trips with hot
+tree sync, micro-batching, command interface, HR-scope rendezvous (loopback
+responder pattern), self-authorized CRUD, cache invalidation
+(coverage model: the reference's microservice + acs-enabled suites)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+from access_control_srv_tpu.srv import Config, Worker
+
+from .utils import URNS, build_request, fixture
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+READ = URNS["read"]
+MODIFY = URNS["modify"]
+
+SEED = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "data", "seed_data")
+
+PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+
+
+def seed_cfg(**overrides):
+    cfg = {
+        "policies": {"type": "database"},
+        "seed_data": {
+            "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+            "policies": os.path.join(SEED, "policies.yaml"),
+            "rules": os.path.join(SEED, "rules.yaml"),
+        },
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+def admin_request(role="superadministrator-r-id", action=READ):
+    return build_request(
+        subject_id="root",
+        subject_role=role,
+        role_scoping_entity=ORG,
+        role_scoping_instance="system",
+        resource_type=ORG,
+        resource_id="O1",
+        action_type=action,
+    )
+
+
+@pytest.fixture()
+def worker():
+    w = Worker().start(seed_cfg())
+    yield w
+    w.stop()
+
+
+class TestWorkerBoot:
+    def test_seed_policies_loaded(self, worker):
+        assert "global_policy_set" in worker.engine.policy_sets
+
+    def test_super_admin_permit(self, worker):
+        response = worker.service.is_allowed(admin_request())
+        assert response.decision == Decision.PERMIT
+        assert response.operation_status.code == 200
+
+    def test_ordinary_user_indeterminate(self, worker):
+        response = worker.service.is_allowed(admin_request(role="nobody"))
+        assert response.decision == Decision.INDETERMINATE
+
+    def test_health_and_version(self, worker):
+        health = worker.command_interface.command("health_check")
+        assert health["status"] == "SERVING"
+        version = worker.command_interface.command("version")
+        assert version["version"]
+
+
+class TestCrudHotSync:
+    def rule_doc(self, rid="r_reader", role="reader-role"):
+        return {
+            "id": rid,
+            "name": rid,
+            "target": {
+                "subjects": [{"id": URNS["role"], "value": role}],
+                "resources": [{"id": URNS["entity"], "value": ORG}],
+                "actions": [{"id": URNS["actionID"], "value": READ}],
+            },
+            "effect": "PERMIT",
+        }
+
+    def test_create_updates_decisions(self, worker):
+        reader_req = admin_request(role="reader-role")
+        assert worker.service.is_allowed(reader_req).decision == \
+            Decision.INDETERMINATE
+
+        rules = worker.store.get_resource_service("rule")
+        policies = worker.store.get_resource_service("policy")
+        sets = worker.store.get_resource_service("policy_set")
+        assert rules.create([self.rule_doc()])["operation_status"]["code"] == 200
+        policies.create(
+            [{"id": "p_readers", "combining_algorithm": PO, "rules": ["r_reader"]}]
+        )
+        sets.create(
+            [{"id": "ps_readers", "combining_algorithm": PO,
+              "policies": ["p_readers"]}]
+        )
+        # hot sync: in-memory tree and kernel both updated
+        assert "ps_readers" in worker.engine.policy_sets
+        assert worker.service.is_allowed(reader_req).decision == Decision.PERMIT
+
+    def test_update_rule_flips_effect(self, worker):
+        self.test_create_updates_decisions(worker)
+        rules = worker.store.get_resource_service("rule")
+        doc = self.rule_doc()
+        doc["effect"] = "DENY"
+        rules.update([doc])
+        response = worker.service.is_allowed(admin_request(role="reader-role"))
+        assert response.decision == Decision.DENY
+
+    def test_delete_rule_restores_indeterminate(self, worker):
+        self.test_create_updates_decisions(worker)
+        worker.store.get_resource_service("rule").delete(ids=["r_reader"])
+        response = worker.service.is_allowed(admin_request(role="reader-role"))
+        # the policy now has a missing (None) child and no effects
+        assert response.decision == Decision.INDETERMINATE
+
+    def test_crud_events_emitted(self, worker):
+        topic = worker.bus.topic("io.restorecommerce.rules.resource")
+        before = topic.offset
+        worker.store.get_resource_service("rule").create([self.rule_doc("r_evt")])
+        events = topic.read(before)
+        assert ("ruleCreated", ) == tuple(e for e, _ in events)[:1]
+
+
+class TestMicroBatcher:
+    def test_concurrent_submits(self, worker):
+        futures = [
+            worker.batcher.submit(admin_request())
+            for _ in range(32)
+        ] + [
+            worker.batcher.submit(admin_request(role="nobody"))
+            for _ in range(32)
+        ]
+        results = [f.result(timeout=30) for f in futures]
+        assert all(r.decision == Decision.PERMIT for r in results[:32])
+        assert all(r.decision == Decision.INDETERMINATE for r in results[32:])
+
+
+class TestCommandInterface:
+    def test_reset_then_restore(self, worker):
+        assert worker.service.is_allowed(admin_request()).decision == \
+            Decision.PERMIT
+        worker.command_interface.command("reset")
+        assert worker.service.is_allowed(admin_request()).decision == \
+            Decision.INDETERMINATE
+        # re-seed + restore
+        worker.store.seed(
+            *[__import__("yaml").safe_load(open(os.path.join(SEED, f)))
+              for f in ("policy_sets.yaml", "policies.yaml", "rules.yaml")]
+        )
+        worker.command_interface.command("restore")
+        assert worker.service.is_allowed(admin_request()).decision == \
+            Decision.PERMIT
+
+    def test_config_update(self, worker):
+        worker.command_interface.command(
+            "config_update", {"authorization:hrReqTimeout": 1234}
+        )
+        assert worker.cfg.get("authorization:hrReqTimeout") == 1234
+
+    def test_command_via_topic(self, worker):
+        worker.bus.topic("io.restorecommerce.command").emit(
+            "command", {"name": "set_api_key", "payload": {"apiKey": "k1"}}
+        )
+        assert worker.command_interface.api_key == "k1"
+
+
+class TestHRScopeRendezvous:
+    def test_cached_scopes_resolve_without_rendezvous(self, worker):
+        worker.identity_client.register(
+            "tok-1",
+            {
+                "id": "ada",
+                "tokens": [{"token": "tok-1", "interactive": True}],
+                "role_associations": [
+                    {"role": "superadministrator-r-id", "attributes": []}
+                ],
+            },
+        )
+        worker.subject_cache.set("cache:ada:hrScopes", [{"id": "Org1"}])
+        request = admin_request()
+        request.context["subject"] = {"token": "tok-1"}
+        response = worker.service.is_allowed(request)
+        assert response.decision == Decision.PERMIT
+        assert request.context["subject"]["hierarchical_scopes"] == [
+            {"id": "Org1"}
+        ]
+
+    def test_rendezvous_loopback(self, worker):
+        """The suite-3 pattern: a test responder consumes
+        hierarchicalScopesRequest and emits the response back."""
+        worker.identity_client.register(
+            "tok-2",
+            {
+                "id": "ben",
+                "tokens": [{"token": "tok-2", "interactive": True}],
+                "role_associations": [
+                    {"role": "superadministrator-r-id", "attributes": []}
+                ],
+            },
+        )
+        auth_topic = worker.bus.topic("io.restorecommerce.authentication")
+
+        def responder(event_name, message, ctx):
+            if event_name != "hierarchicalScopesRequest":
+                return
+            token_date = message["token"]
+
+            def reply():
+                auth_topic.emit(
+                    "hierarchicalScopesResponse",
+                    {
+                        "token": token_date,
+                        "subject_id": "ben",
+                        "interactive": True,
+                        "hierarchical_scopes": [{"id": "OrgB"}],
+                    },
+                )
+
+            threading.Thread(target=reply, daemon=True).start()
+
+        auth_topic.on(responder)
+        request = admin_request()
+        request.context["subject"] = {"token": "tok-2"}
+        response = worker.service.is_allowed(request)
+        assert response.decision == Decision.PERMIT
+        assert worker.subject_cache.get("cache:ben:hrScopes") == [{"id": "OrgB"}]
+
+    def test_rendezvous_timeout(self):
+        w = Worker().start(seed_cfg(authorization={"hrReqTimeout": 50}))
+        try:
+            w.identity_client.register(
+                "tok-3",
+                {
+                    "id": "eve",
+                    "tokens": [{"token": "tok-3", "interactive": True}],
+                    "role_associations": [],
+                },
+            )
+            request = admin_request(role="nobody")
+            request.context["subject"] = {"token": "tok-3"}
+            t0 = time.time()
+            response = w.service.is_allowed(request)
+            assert time.time() - t0 < 5
+            assert response.decision == Decision.INDETERMINATE
+        finally:
+            w.stop()
+
+
+class TestSelfAuthorizedCrud:
+    def test_unauthorized_create_denied(self):
+        w = Worker().start(seed_cfg(authorization={
+            "enabled": True, "enforce": True, "hrReqTimeout": 50,
+        }))
+        try:
+            rules = w.store.get_resource_service("rule")
+            result = rules.create(
+                [{"id": "r_x", "effect": "PERMIT"}],
+                subject={"id": "mallory", "scope": "otherOrg"},
+            )
+            assert result["operation_status"]["code"] == 403
+            assert w.store.collections["rule"].get("r_x") is None
+        finally:
+            w.stop()
+
+    def test_authorized_create_permitted(self):
+        w = Worker().start(seed_cfg(authorization={
+            "enabled": True, "enforce": True, "hrReqTimeout": 50,
+        }))
+        try:
+            rules = w.store.get_resource_service("rule")
+            result = rules.create(
+                [{"id": "r_y", "effect": "PERMIT"}],
+                subject={
+                    "id": "root",
+                    "scope": "system",
+                    "role_associations": [
+                        {"role": "superadministrator-r-id", "attributes": []}
+                    ],
+                    "hierarchical_scopes": [],
+                },
+            )
+            assert result["operation_status"]["code"] == 200
+            assert w.store.collections["rule"].get("r_y") is not None
+        finally:
+            w.stop()
+
+
+class TestCacheInvalidation:
+    def test_user_deleted_evicts(self, worker):
+        worker.subject_cache.set("cache:u1:hrScopes", [{"id": "X"}])
+        worker.bus.topic("io.restorecommerce.users.resource").emit(
+            "userDeleted", {"id": "u1"}
+        )
+        assert worker.subject_cache.get("cache:u1:hrScopes") is None
+
+    def test_user_modified_evicts_on_change(self, worker):
+        worker.subject_cache.set(
+            "cache:u2:subject",
+            {"role_associations": [{"role": "a", "attributes": []}]},
+        )
+        worker.subject_cache.set("cache:u2:hrScopes", [{"id": "X"}])
+        worker.bus.topic("io.restorecommerce.users.resource").emit(
+            "userModified",
+            {"id": "u2", "role_associations": [{"role": "b", "attributes": []}]},
+        )
+        assert worker.subject_cache.get("cache:u2:hrScopes") is None
+
+    def test_user_modified_keeps_on_no_change(self, worker):
+        assocs = [{"role": "a", "attributes": []}]
+        worker.subject_cache.set("cache:u3:subject", {"role_associations": assocs})
+        worker.subject_cache.set("cache:u3:hrScopes", [{"id": "X"}])
+        worker.bus.topic("io.restorecommerce.users.resource").emit(
+            "userModified", {"id": "u3", "role_associations": assocs}
+        )
+        assert worker.subject_cache.get("cache:u3:hrScopes") == [{"id": "X"}]
+
+
+class TestLocalPolicyMode:
+    def test_local_yaml_load(self):
+        w = Worker().start(
+            {
+                "policies": {
+                    "type": "local",
+                    "paths": [fixture("basic_policies.yml")],
+                }
+            }
+        )
+        try:
+            request = build_request(
+                subject_id="ada", subject_role="member",
+                role_scoping_entity=ORG, role_scoping_instance="Org1",
+                resource_type=ORG, resource_id="X",
+                resource_property=ORG + "#name", action_type=READ,
+            )
+            assert w.service.is_allowed(request).decision == Decision.PERMIT
+        finally:
+            w.stop()
+
+
+class TestAdapterContextQuery:
+    def test_graphql_context_query_drives_condition(self):
+        import json
+
+        def transport(url, body, headers):
+            return json.dumps(
+                {
+                    "data": {
+                        "getAllAddresses": {
+                            "details": [{"payload": {"country_id": "DE"}}],
+                            "operation_status": {"code": 200, "message": "ok"},
+                        }
+                    }
+                }
+            ).encode()
+
+        w = Worker().start(
+            {
+                "policies": {"type": "local", "paths": []},
+                "adapter": {
+                    "graphql": {"url": "http://example/graphql",
+                                "transport": transport}
+                },
+            }
+        )
+        try:
+            from access_control_srv_tpu.core.loader import load_policy_sets
+
+            doc = {
+                "policy_sets": [{
+                    "id": "ps_cq", "combining_algorithm": PO,
+                    "policies": [{
+                        "id": "p_cq", "combining_algorithm": PO,
+                        "rules": [{
+                            "id": "r_cq", "effect": "PERMIT",
+                            "target": {
+                                "subjects": [{"id": URNS["role"],
+                                              "value": "member"}],
+                            },
+                            "context_query": {
+                                "query": "query { getAllAddresses { ... } }",
+                                "filters": [],
+                            },
+                            "condition": (
+                                "any(r.country_id == 'DE' "
+                                "for r in context._queryResult)"
+                            ),
+                        }],
+                    }],
+                }]
+            }
+            for ps in load_policy_sets(doc):
+                w.engine.update_policy_set(ps)
+            w.evaluator.refresh()
+            request = build_request(
+                subject_id="ada", subject_role="member",
+                role_scoping_entity=ORG, role_scoping_instance="Org1",
+                resource_type=ORG, resource_id="X", action_type=READ,
+            )
+            assert w.service.is_allowed(request).decision == Decision.PERMIT
+        finally:
+            w.stop()
