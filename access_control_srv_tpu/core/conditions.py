@@ -79,22 +79,328 @@ def _wrap(value: Any):
     return value
 
 
+class ConditionBudgetExceeded(RuntimeError):
+    code = 500
+
+
+# Caps on work done inside C-level builtins, where the sys.settrace budget
+# cannot see: max items any builtin may consume from an iterable, max length
+# of a sequence produced by +/*, max bit-length of an integer produced by
+# arithmetic.  Exceeding any of them raises ConditionBudgetExceeded, which
+# the engine converts into deny-by-default.
+_MAX_ITER_ITEMS = 100_000
+_MAX_SEQ_LEN = 1_000_000
+_MAX_INT_BITS = 65_536
+# cumulative sequence bytes one evaluation may allocate through the guarded
+# ops: bounds loops that build many individually-legal sequences
+_MAX_TOTAL_ALLOC = 8 * _MAX_SEQ_LEN
+
+_ALLOC_STATE = __import__("threading").local()
+
+
+def _charge_alloc(n: int) -> None:
+    remaining = getattr(_ALLOC_STATE, "remaining", None)
+    if remaining is None:
+        return
+    remaining -= max(n, 0)
+    if remaining < 0:
+        raise ConditionBudgetExceeded("condition allocated too much memory")
+    _ALLOC_STATE.remaining = remaining
+
+
+def _capped(iterable):
+    """Bound how many items a C-level consumer (sum/list/dict/...) may pull
+    from ``iterable``; sized inputs are checked up front, lazy ones are
+    wrapped in a counting generator."""
+    try:
+        n = len(iterable)
+    except TypeError:
+        def gen():
+            for i, item in enumerate(iterable):
+                if i >= _MAX_ITER_ITEMS:
+                    raise ConditionBudgetExceeded(
+                        "condition iterated over too many items"
+                    )
+                yield item
+        return gen()
+    except OverflowError:
+        raise ConditionBudgetExceeded("condition iterated over too many items")
+    if n > _MAX_ITER_ITEMS:
+        raise ConditionBudgetExceeded("condition iterated over too many items")
+    return iterable
+
+
+def _capped_consumer(fn):
+    def wrapper(iterable=(), *args, **kwargs):
+        return fn(_capped(iterable), *args, **kwargs)
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+def _capped_minmax(fn):
+    def wrapper(*args, **kwargs):
+        if len(args) == 1:
+            return fn(_capped(args[0]), **kwargs)
+        return fn(*args, **kwargs)
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+def _safe_sum(iterable=(), start=0):
+    # a list/tuple start turns sum() into C-level sequence concatenation
+    # ('sum([s, s], [])' == 's + s' with no _g_add in sight)
+    if not isinstance(start, (int, float)):
+        raise ConditionBudgetExceeded(
+            "sum() start must be numeric in conditions"
+        )
+    return sum(_capped(iterable), start)
+
+
+def _capped_dict(arg=None, **kwargs):
+    if arg is None:
+        return dict(**kwargs)
+    if isinstance(arg, dict):
+        return dict(arg, **kwargs)
+    return dict(_capped(arg), **kwargs)
+
+
+def _seq_len(value) -> int | None:
+    if isinstance(value, (str, bytes, list, tuple)):
+        return len(value)
+    return None
+
+
+def _guard_int(value):
+    if isinstance(value, int) and value.bit_length() > _MAX_INT_BITS:
+        raise ConditionBudgetExceeded("condition produced an oversized integer")
+    return value
+
+
+def _g_add(a, b):
+    la, lb = _seq_len(a), _seq_len(b)
+    if la is not None and lb is not None:
+        if la + lb > _MAX_SEQ_LEN:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized sequence"
+            )
+        _charge_alloc(la + lb)
+    return a + b
+
+
+def _g_mul(a, b):
+    for seq, times in ((a, b), (b, a)):
+        n = _seq_len(seq)
+        if n is not None and isinstance(times, int):
+            produced = n * max(times, 0)
+            if produced > _MAX_SEQ_LEN:
+                raise ConditionBudgetExceeded(
+                    "condition produced an oversized sequence"
+                )
+            _charge_alloc(produced)
+    if isinstance(a, int) and isinstance(b, int):
+        if a.bit_length() + b.bit_length() > _MAX_INT_BITS:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized integer"
+            )
+    return a * b
+
+
+_WIDE_FORMAT = re.compile(r"\d{7}")
+# '%*d' / '%.*f' take the pad width from the args tuple, sidestepping any
+# scan of the format string itself
+_STAR_FORMAT = re.compile(r"%[^a-zA-Z%]*\*")
+
+
+def _g_mod(a, b):
+    # '%'-formatting can allocate via width specifiers ('%099999999999d')
+    if isinstance(a, (str, bytes)):
+        text = a if isinstance(a, str) else a.decode("latin1", "ignore")
+        if _WIDE_FORMAT.search(text) or _STAR_FORMAT.search(text):
+            raise ConditionBudgetExceeded(
+                "condition used an oversized or dynamic format width"
+            )
+        result = a % b
+        _charge_alloc(len(result))
+        return result
+    return a % b
+
+
+def _g_replace(obj, *args):
+    if (
+        isinstance(obj, (str, bytes))
+        and len(args) >= 2
+        and isinstance(args[0], type(obj))
+        and isinstance(args[1], type(obj))
+    ):
+        old, new = args[0], args[1]
+        occurrences = obj.count(old) if len(old) > 0 else len(obj) + 1
+        if len(args) > 2 and isinstance(args[2], int) and args[2] >= 0:
+            occurrences = min(occurrences, args[2])
+        projected = len(obj) + occurrences * (len(new) - len(old))
+        if projected > _MAX_SEQ_LEN:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized sequence"
+            )
+        _charge_alloc(max(projected, len(obj)))
+    return obj.replace(*args)
+
+
+def _g_join(obj, *args):
+    if isinstance(obj, (str, bytes)) and len(args) == 1:
+        items = list(_capped(args[0]))
+        total = len(obj) * max(len(items) - 1, 0) + sum(
+            len(x) for x in items if isinstance(x, (str, bytes))
+        )
+        if total > _MAX_SEQ_LEN:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized sequence"
+            )
+        _charge_alloc(total)
+        return obj.join(items)
+    return obj.join(*args)
+
+
+def _g_extend(obj, *args):
+    # list.extend consumes a possibly-unbounded iterator in one C call
+    if isinstance(obj, list) and len(args) == 1:
+        items = list(_capped(args[0]))
+        if len(obj) + len(items) > _MAX_ITER_ITEMS:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized sequence"
+            )
+        _charge_alloc(len(items))
+        return obj.extend(items)
+    return obj.extend(*args)
+
+
+def _g_update(obj, *args, **kwargs):
+    # set.update / dict.update: same single-C-call consumption as extend
+    if isinstance(obj, (set, dict)) and len(args) == 1 and not kwargs:
+        src = args[0]
+        if isinstance(src, dict):
+            items = src
+        else:
+            items = list(_capped(src))
+        if len(obj) + len(items) > _MAX_ITER_ITEMS:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized collection"
+            )
+        _charge_alloc(len(items))
+        return obj.update(items)
+    return obj.update(*args, **kwargs)
+
+
+def _g_pow(a, b):
+    if isinstance(a, int) and isinstance(b, int) and not isinstance(b, bool):
+        if a.bit_length() * max(b, 1) > _MAX_INT_BITS:
+            raise ConditionBudgetExceeded(
+                "condition produced an oversized integer"
+            )
+    return _guard_int(a ** b)
+
+
+def _g_lshift(a, b):
+    if isinstance(b, int) and b > _MAX_INT_BITS:
+        raise ConditionBudgetExceeded("condition produced an oversized integer")
+    return _guard_int(a << b)
+
+
+_GUARDED_BINOPS = {
+    ast.Add: "_g_add",
+    ast.Mult: "_g_mul",
+    ast.Pow: "_g_pow",
+    ast.LShift: "_g_lshift",
+    ast.Mod: "_g_mod",
+}
+
+_GUARDED_METHODS = {
+    "replace": "_g_replace",
+    "join": "_g_join",
+    "extend": "_g_extend",
+    "update": "_g_update",
+}
+
+
+class _GuardBinOps(ast.NodeTransformer):
+    """Rewrite ``a + b`` / ``a * b`` / ``a ** b`` / ``a << b`` into calls to
+    the guarded helpers above, so C-level bignum/sequence blowups are caught
+    even though no trace event fires inside them."""
+
+    def visit_BinOp(self, node: ast.BinOp):
+        self.generic_visit(node)
+        name = _GUARDED_BINOPS.get(type(node.op))
+        if name is None:
+            return node
+        return ast.copy_location(
+            ast.Call(
+                func=ast.copy_location(ast.Name(id=name, ctx=ast.Load()), node),
+                args=[node.left, node.right],
+                keywords=[],
+            ),
+            node,
+        )
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.generic_visit(node)
+        name = _GUARDED_BINOPS.get(type(node.op))
+        if name is None or not isinstance(node.target, ast.Name):
+            return node
+        load = ast.copy_location(
+            ast.Name(id=node.target.id, ctx=ast.Load()), node
+        )
+        call = ast.copy_location(
+            ast.Call(
+                func=ast.copy_location(ast.Name(id=name, ctx=ast.Load()), node),
+                args=[load, node.value],
+                keywords=[],
+            ),
+            node,
+        )
+        return ast.copy_location(
+            ast.Assign(targets=[node.target], value=call), node
+        )
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        # route str.replace / str.join through size-checked helpers; calls
+        # with keywords are left alone (str forms take none)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _GUARDED_METHODS
+            and not node.keywords
+        ):
+            return ast.copy_location(
+                ast.Call(
+                    func=ast.copy_location(
+                        ast.Name(
+                            id=_GUARDED_METHODS[node.func.attr], ctx=ast.Load()
+                        ),
+                        node,
+                    ),
+                    args=[node.func.value, *node.args],
+                    keywords=[],
+                ),
+                node,
+            )
+        return node
+
+
 _SAFE_BUILTINS = {
     "len": len,
-    "any": any,
-    "all": all,
-    "min": min,
-    "max": max,
-    "sum": sum,
-    "sorted": sorted,
+    "any": _capped_consumer(any),
+    "all": _capped_consumer(all),
+    "min": _capped_minmax(min),
+    "max": _capped_minmax(max),
+    "sum": _safe_sum,
+    "sorted": _capped_consumer(sorted),
     "str": str,
     "int": int,
     "float": float,
     "bool": bool,
-    "list": list,
-    "dict": dict,
-    "set": set,
-    "tuple": tuple,
+    "list": _capped_consumer(list),
+    "dict": _capped_dict,
+    "set": _capped_consumer(set),
+    "tuple": _capped_consumer(tuple),
     "enumerate": enumerate,
     "zip": zip,
     "range": range,
@@ -166,11 +472,18 @@ def _validate_condition_ast(tree: ast.AST) -> None:
                 f"statement {type(node).__name__} is not allowed in conditions"
             )
         if isinstance(node, ast.Attribute) and node.attr in (
-            "format",
-            "format_map",
-        ):
             # str.format traverses dunder attribute chains at runtime
             # ("{0.__class__...}"), bypassing the static dunder ban
+            "format",
+            "format_map",
+            # single-C-call allocators that can build multi-GB strings the
+            # trace budget never sees
+            "zfill",
+            "center",
+            "ljust",
+            "rjust",
+            "expandtabs",
+        ):
             raise ConditionValidationError(
                 f"calling {node.attr!r} is not allowed in conditions"
             )
@@ -194,16 +507,41 @@ def _validate_condition_ast(tree: ast.AST) -> None:
                 raise ConditionValidationError(
                     f"calling {fn.id!r} is not allowed in conditions"
                 )
-
-
-class ConditionBudgetExceeded(RuntimeError):
-    code = 500
+        if (
+            isinstance(node, ast.AugAssign)
+            and type(node.op) in _GUARDED_BINOPS
+            and not isinstance(node.target, ast.Name)
+        ):
+            # only Name targets are rewritten through the guarded helpers;
+            # 's[0] += s[0]' would bypass the growth checks
+            raise ConditionValidationError(
+                "augmented assignment to containers is not allowed in "
+                "conditions; use the expanded 'x = x + y' form"
+            )
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None:
+            # f-string format specs pad in a single C call the trace budget
+            # never sees ("f'{1:>99999999999}'")
+            for part in ast.walk(node.format_spec):
+                if isinstance(part, ast.FormattedValue):
+                    raise ConditionValidationError(
+                        "dynamic format specs are not allowed in conditions"
+                    )
+                if (
+                    isinstance(part, ast.Constant)
+                    and isinstance(part.value, str)
+                    and _WIDE_FORMAT.search(part.value)
+                ):
+                    raise ConditionValidationError(
+                        "oversized format width is not allowed in conditions"
+                    )
 
 
 class _ExecutionBudget:
     """Caps the traced line/call events of a condition evaluation so a
-    hostile/broken condition (``while True``, huge ranges) cannot hang the
-    PDP; the engine converts the raised error into deny-by-default."""
+    hostile/broken condition (``while True``, generator-fed loops) cannot
+    hang the PDP; C-level work invisible to the tracer is bounded separately
+    by the guarded binops and capped consumer builtins above.  The engine
+    converts the raised error into deny-by-default."""
 
     def __init__(self, max_events: int):
         self.remaining = max_events
@@ -250,6 +588,15 @@ def condition_matches(condition: str, request) -> bool:
         "target": target,
         "context": _wrap(context) if isinstance(context, (dict, list)) else context,
         "re": _SafeRegex,
+        "_g_add": _g_add,
+        "_g_mul": _g_mul,
+        "_g_pow": _g_pow,
+        "_g_lshift": _g_lshift,
+        "_g_mod": _g_mod,
+        "_g_replace": _g_replace,
+        "_g_join": _g_join,
+        "_g_extend": _g_extend,
+        "_g_update": _g_update,
     }
 
     try:
@@ -259,20 +606,25 @@ def condition_matches(condition: str, request) -> bool:
         tree = ast.parse(condition, mode="exec")
         is_expression = False
     _validate_condition_ast(tree)
+    tree = ast.fix_missing_locations(_GuardBinOps().visit(tree))
 
-    with _ExecutionBudget(CONDITION_MAX_EVENTS):
-        if is_expression:
-            result = eval(compile(tree, "<condition>", "eval"), env)
-        else:
-            exec(compile(tree, "<condition>", "exec"), env)
-            check = env.get("check")
-            if not callable(check):
-                raise ConditionValidationError(
-                    "multi-line condition must define "
-                    "check(request, target, context)"
-                )
-            return bool(check(request, env["target"], env["context"]))
+    _ALLOC_STATE.remaining = _MAX_TOTAL_ALLOC
+    try:
+        with _ExecutionBudget(CONDITION_MAX_EVENTS):
+            if is_expression:
+                result = eval(compile(tree, "<condition>", "eval"), env)
+            else:
+                exec(compile(tree, "<condition>", "exec"), env)
+                check = env.get("check")
+                if not callable(check):
+                    raise ConditionValidationError(
+                        "multi-line condition must define "
+                        "check(request, target, context)"
+                    )
+                return bool(check(request, env["target"], env["context"]))
 
-        if callable(result):
-            return bool(result(request, env["target"], env["context"]))
-    return bool(result)
+            if callable(result):
+                return bool(result(request, env["target"], env["context"]))
+        return bool(result)
+    finally:
+        _ALLOC_STATE.remaining = None

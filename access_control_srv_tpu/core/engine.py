@@ -112,7 +112,31 @@ class AccessController:
     # ------------------------------------------------------------------ PDP
 
     def clear_policies(self) -> None:
-        self.policy_sets.clear()
+        self.policy_sets = {}
+
+    def replace_policy_sets(self, policy_sets: dict[str, "PolicySet"]) -> None:
+        """Swap the whole tree atomically (single reference assignment):
+        serving threads mid-iteration finish on the old snapshot instead of
+        racing an in-place clear+rebuild."""
+        self.policy_sets = policy_sets
+
+    def prepare_context(self, request: Request) -> None:
+        """Resolve a token subject and its hierarchical scopes host-side.
+        Idempotent; called from is_allowed/what_is_allowed, and by the
+        serving shell BEFORE a request enters the micro-batcher so the
+        collector thread never blocks on the HR-scope rendezvous
+        (reference: accessController.ts:110-123).  Attempted at most once
+        per request: a timed-out rendezvous must not re-block a later
+        evaluation of the same request on another thread."""
+        if getattr(request, "_context_prepared", False):
+            return
+        request._context_prepared = True
+        context = request.context or {}
+        if _get(_get(context, "subject"), "token"):
+            context = self._resolve_subject(context)
+            if not _get(_get(context, "subject"), "hierarchical_scopes"):
+                context = self.create_hr_scope(context)
+            request.context = context
 
     def _resolve_subject(self, context) -> Any:
         """Token -> subject resolution via the identity client
@@ -151,12 +175,8 @@ class AccessController:
 
         effect: Optional[EffectEvaluation] = None
         obligations: list[Attribute] = []
+        self.prepare_context(request)
         context = request.context or {}
-        if _get(_get(context, "subject"), "token"):
-            context = self._resolve_subject(context)
-            if not _get(_get(context, "subject"), "hierarchical_scopes"):
-                context = self.create_hr_scope(context)
-                request.context = context
 
         entity_urn = self.urns.get("entity")
 
@@ -355,12 +375,8 @@ class AccessController:
         (reference: accessController.ts:326-427)."""
         policy_sets_rq: list[PolicySetRQ] = []
         obligations: list[Attribute] = []
+        self.prepare_context(request)
         context = request.context or {}
-        if _get(_get(context, "subject"), "token"):
-            context = self._resolve_subject(context)
-            if not _get(_get(context, "subject"), "hierarchical_scopes"):
-                context = self.create_hr_scope(context)
-                request.context = context
 
         entity_urn = self.urns.get("entity")
 

@@ -482,13 +482,42 @@ class DecisionKernel:
         self._run = jax.jit(partial(run, self._c))
 
     def evaluate(self, batch: RequestBatch):
-        """Returns (decision, cacheable, status) numpy arrays [B]."""
+        """Returns (decision, cacheable, status) numpy arrays [B].
+
+        The batch axis is padded to a power-of-two bucket before entering
+        jit: without bucketing every distinct batch size is a fresh XLA
+        compile, which would stall a micro-batched serving path on nearly
+        every call.  Rows are independent under vmap, so zero-padded rows
+        cannot affect real rows; their outputs are sliced away."""
+        b = batch.arrays[next(iter(batch.arrays))].shape[0]
+        bucket = max(8, 1 << max(b - 1, 1).bit_length())
+
+        def pad_lead(a: np.ndarray) -> np.ndarray:
+            a = np.asarray(a)
+            if a.shape[0] == bucket:
+                return a
+            fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
+            return np.concatenate([a, fill], axis=0)
+
+        def pad_cols(a: np.ndarray, width: int) -> np.ndarray:
+            # conditions are [n_cond, B]; regex matrices are [W, E]
+            a = np.asarray(a)
+            if a.shape[1] == width:
+                return a
+            fill = np.zeros(a.shape[:1] + (width - a.shape[1],), a.dtype)
+            return np.concatenate([a, fill], axis=1)
+
+        # distinct-entity count also varies per batch; bucket it too so the
+        # regex matrices keep a stable compiled shape
+        e = batch.rgx_set.shape[1]
+        e_bucket = max(8, 1 << max(e - 1, 1).bit_length())
+
         out = self._run(
-            {k: jnp.asarray(v) for k, v in batch.arrays.items()},
-            jnp.asarray(batch.rgx_set),
-            jnp.asarray(batch.pfx_neq),
-            jnp.asarray(batch.cond_true),
-            jnp.asarray(batch.cond_abort),
-            jnp.asarray(batch.cond_code),
+            {k: jnp.asarray(pad_lead(v)) for k, v in batch.arrays.items()},
+            jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
+            jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
+            jnp.asarray(pad_cols(batch.cond_true, bucket)),
+            jnp.asarray(pad_cols(batch.cond_abort, bucket)),
+            jnp.asarray(pad_cols(batch.cond_code, bucket)),
         )
-        return tuple(np.asarray(x) for x in out)
+        return tuple(np.asarray(x)[:b] for x in out)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional
 
@@ -62,24 +63,35 @@ class MicroBatcher:
             except queue.Empty:
                 continue
             batch = [first]
-            deadline = self.window_s
+            # the collection window closes window_s after the FIRST item;
+            # later arrivals only get the remaining slice, so a steady
+            # trickle cannot stretch collection toward max_batch * window
+            close_at = time.monotonic() + self.window_s
             try:
                 while len(batch) < self.max_batch:
-                    item = self._queue.get(timeout=deadline)
+                    remaining = close_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    item = self._queue.get(timeout=remaining)
                     batch.append(item)
             except queue.Empty:
                 pass
             requests = [req for req, _ in batch]
-            try:
-                if len(batch) < self.min_kernel_batch:
-                    responses = [
-                        self.evaluator.is_allowed(req) for req in requests
-                    ]
-                else:
+            responses = None
+            if len(batch) >= self.min_kernel_batch:
+                try:
                     responses = self.evaluator.is_allowed_batch(requests)
+                except Exception:
+                    # one poisoned request must not deny the whole batch;
+                    # retry each request individually below
+                    responses = None
+            if responses is not None:
                 for (_, future), response in zip(batch, responses):
                     future.set_result(response)
-            except Exception as err:  # pragma: no cover
-                for _, future in batch:
-                    if not future.done():
-                        future.set_exception(err)
+            else:
+                for req, future in batch:
+                    try:
+                        future.set_result(self.evaluator.is_allowed(req))
+                    except Exception as err:
+                        if not future.done():
+                            future.set_exception(err)

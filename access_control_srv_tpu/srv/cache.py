@@ -105,6 +105,15 @@ class HRScopeProvider:
             )
             released = event.wait(self.timeout_ms / 1000.0)
             if not released:
+                # un-park on timeout or the waiting map leaks one entry per
+                # request (token_date keys are unique per call)
+                with self._lock:
+                    events = self.waiting.get(token_date)
+                    if events is not None:
+                        if event in events:
+                            events.remove(event)
+                        if not events:
+                            del self.waiting[token_date]
                 if self.logger:
                     self.logger.error(
                         "hr scope read timed out", extra={"token": token_date}

@@ -28,7 +28,10 @@ class CommandInterface:
             bus.topic("io.restorecommerce.command").on(self._on_command)
 
     def _on_command(self, event_name: str, message: Any, ctx: dict) -> None:
-        if event_name != "command":
+        # the reference fans every *Command event into the command interface
+        # (reference: src/worker.ts:347, cfg events list incl.
+        # flushCacheCommand/restoreCommand/...)
+        if event_name != "command" and not event_name.endswith("Command"):
             return
         name = (message or {}).get("name")
         payload = (message or {}).get("payload")

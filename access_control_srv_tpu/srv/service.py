@@ -70,6 +70,9 @@ class AccessControlService:
         self.evaluator = evaluator
         self.store = store
         self.logger = logger
+        # when set (Worker wires it), concurrent single isAllowed calls are
+        # coalesced into kernel batches instead of hitting the oracle 1-by-1
+        self.batcher = None
 
     # ------------------------------------------------------------- endpoints
 
@@ -78,6 +81,12 @@ class AccessControlService:
         (reference: accessControlService.ts:62-81)."""
         try:
             req = coerce_request(request)
+            if self.batcher is not None:
+                # resolve token subject + HR scopes in THIS thread: the
+                # rendezvous can block for up to hrReqTimeout, which must
+                # never happen on the batcher's collector thread
+                self.engine.prepare_context(req)
+                return self.batcher.is_allowed(req)
             if self.evaluator is not None:
                 return self.evaluator.is_allowed(req)
             return self.engine.is_allowed(req)
@@ -107,9 +116,23 @@ class AccessControlService:
                 Response(decision=Decision.DENY, operation_status=status)
                 for _ in requests
             ]
-        if self.evaluator is not None:
-            return self.evaluator.is_allowed_batch(reqs)
-        return [self.engine.is_allowed(r) for r in reqs]
+        try:
+            if self.evaluator is not None:
+                return self.evaluator.is_allowed_batch(reqs)
+            return [self.engine.is_allowed(r) for r in reqs]
+        except Exception as err:
+            # same deny-on-exception contract as the single-request path
+            if self.logger:
+                self.logger.exception("isAllowedBatch failed")
+            code = getattr(err, "code", 500)
+            status = OperationStatus(
+                code=code if isinstance(code, int) else 500,
+                message=str(err) or "Unknown Error!",
+            )
+            return [
+                Response(decision=Decision.DENY, operation_status=status)
+                for _ in reqs
+            ]
 
     def what_is_allowed(self, request: Any) -> ReverseQuery:
         """(reference: accessControlService.ts:83-101)"""

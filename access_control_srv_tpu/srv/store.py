@@ -55,6 +55,14 @@ class Collection:
             self._docs[doc["id"]] = copy.deepcopy(doc)
             self._snapshot()
 
+    def upsert_many(self, docs: list[dict]) -> None:
+        """Bulk path: one lock acquisition and one snapshot for the whole
+        list (per-doc upsert would rewrite the full snapshot n times)."""
+        with self._lock:
+            for doc in docs:
+                self._docs[doc["id"]] = copy.deepcopy(doc)
+            self._snapshot()
+
     def insert(self, doc: dict) -> bool:
         with self._lock:
             if doc["id"] in self._docs:
@@ -232,11 +240,11 @@ class ResourceService:
         self.store.sync_after_mutation(self.kind, "upsert", items)
         return {"items": results, "operation_status": _op_status()}
 
-    def super_upsert(self, items: list[dict]) -> dict:
+    def super_upsert(self, items: list[dict], sync: bool = True) -> dict:
         """Seed-data path: no authorization (reference: src/worker.ts:228)."""
-        for doc in items:
-            self.collection.upsert(copy.deepcopy(doc))
-        self.store.sync_after_mutation(self.kind, "upsert", items)
+        self.collection.upsert_many(items)
+        if sync:
+            self.store.sync_after_mutation(self.kind, "upsert", items)
         return {"operation_status": _op_status()}
 
     def read(self, filters: Optional[dict] = None) -> dict:
@@ -311,7 +319,9 @@ class PolicyStore:
 
     def load(self) -> None:
         """Compose the 3-level tree from the flat collections and swap it
-        into the engine (reference: PolicySetService.load)."""
+        into the engine (reference: PolicySetService.load).  The new tree is
+        built aside and swapped in with one reference assignment so serving
+        threads never observe a cleared or half-built tree."""
         rules = {d["id"]: rule_from_dict(d) for d in self.collections["rule"].all()}
         policies = {}
         for p_doc in self.collections["policy"].all():
@@ -325,7 +335,7 @@ class PolicyStore:
                 for i, r in enumerate(child_rules)
             }
             policies[p_doc["id"]] = policy
-        self.engine.clear_policies()
+        tree: dict = {}
         for ps_doc in self.collections["policy_set"].all():
             child_policies = []
             for pid in ps_doc.get("policies") or []:
@@ -335,7 +345,8 @@ class PolicyStore:
                 (p.id if p is not None else f"__missing_{i}"): p
                 for i, p in enumerate(child_policies)
             }
-            self.engine.update_policy_set(policy_set)
+            tree[policy_set.id] = policy_set
+        self.engine.replace_policy_sets(tree)
         if self.evaluator is not None:
             self.evaluator.refresh()
 
@@ -348,7 +359,10 @@ class PolicyStore:
         self.load()
 
     def seed(self, policy_set_docs, policy_docs, rule_docs) -> None:
-        """superUpsert seed loading (reference: src/worker.ts:200-242)."""
-        self.services["rule"].super_upsert(rule_docs)
-        self.services["policy"].super_upsert(policy_docs)
-        self.services["policy_set"].super_upsert(policy_set_docs)
+        """superUpsert seed loading (reference: src/worker.ts:200-242).
+        Per-kind sync is suppressed so startup pays one tree compose +
+        evaluator compile instead of three partial ones."""
+        self.services["rule"].super_upsert(rule_docs, sync=False)
+        self.services["policy"].super_upsert(policy_docs, sync=False)
+        self.services["policy_set"].super_upsert(policy_set_docs, sync=False)
+        self.load()

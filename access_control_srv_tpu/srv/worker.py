@@ -131,6 +131,7 @@ class Worker:
             max_batch=cfg.get("evaluator:micro_batch_max", 4096),
         )
         self.batcher.start()
+        self.service.batcher = self.batcher
 
         # event listeners (reference: src/worker.ts:249-361)
         auth_topic.on(self._auth_listener)

@@ -49,6 +49,25 @@ def test_escape_blocked(condition):
         "def check(request, target, context):\n    while True:\n        pass",
         "sum(1 for i in range(10**12)) > 0",
         "all(True for a in range(10**9) for b in range(10**9))",
+        # C-level loops/allocations the trace budget never sees
+        "sum(range(10**12)) > 0",
+        "len('x' * 10**10) > 0",
+        "10**10**8 > 0",
+        "(1 << 10**9) > 0",
+        "max(range(10**13)) > 0",
+        "len(list(zip(range(10**10), range(10**10)))) > 0",
+        "len(dict(zip(range(10**10), range(10**10)))) > 0",
+        "len(sorted(range(10**10))) > 0",
+        "def check(request, target, context):\n"
+        "    s = 'xx'\n"
+        "    for i in range(200):\n"
+        "        s = s + s\n"
+        "    return True",
+        "def check(request, target, context):\n"
+        "    s = 'xx'\n"
+        "    for i in range(200):\n"
+        "        s *= 2\n"
+        "    return True",
     ],
 )
 def test_runaway_budget(condition):
@@ -56,6 +75,126 @@ def test_runaway_budget(condition):
     with pytest.raises(ConditionBudgetExceeded):
         condition_matches(condition, REQ)
     assert time.time() - t0 < 5
+
+
+@pytest.mark.parametrize(
+    "condition",
+    ["'x'.zfill(10**9)", "'x'.center(10**9)", "'x'.rjust(10**9)"],
+)
+def test_allocator_methods_banned(condition):
+    with pytest.raises(ConditionValidationError):
+        condition_matches(condition, REQ)
+
+
+@pytest.mark.parametrize(
+    "condition",
+    [
+        # subscript AugAssign would bypass the guarded-binop rewrite
+        "def check(request, target, context):\n"
+        "    s = ['xx']\n"
+        "    for i in range(200):\n"
+        "        s[0] += s[0]\n"
+        "    return True",
+        # oversized f-string format-spec widths
+        "len(f'{1:>99999999999}') > 0",
+        # dynamic format specs
+        "len(f'{1:{99999999999}}') > 0",
+    ],
+)
+def test_validation_blocks_alloc_bypasses(condition):
+    with pytest.raises(ConditionValidationError):
+        condition_matches(condition, REQ)
+
+
+@pytest.mark.parametrize(
+    "condition",
+    [
+        # %-format width allocators
+        "len('%099999999999d' % 1) > 0",
+        # replace amplification: 1M * 1M -> 10^12 chars
+        "len(('a' * 1000000).replace('a', 'b' * 1000000)) > 0",
+        # join amplification
+        "len('-'.join('a' * 1000000 for i in range(100000))) > 0",
+        # cumulative allocation: each 1M string is individually legal
+        "def check(request, target, context):\n"
+        "    parts = []\n"
+        "    for i in range(100000):\n"
+        "        parts = parts + ['a' * 1000000]\n"
+        "    return True",
+        # single-C-call bulk mutators consuming unbounded iterators
+        "def check(request, target, context):\n"
+        "    s = []\n"
+        "    s.extend(zip(range(10**10), range(10**10)))\n"
+        "    return True",
+        "def check(request, target, context):\n"
+        "    s = set()\n"
+        "    s.update(range(10**10))\n"
+        "    return True",
+        # sum() with a sequence start = unguarded list concatenation
+        "def check(request, target, context):\n"
+        "    s = list(range(1000))\n"
+        "    for i in range(40):\n"
+        "        s = sum([s, s], [])\n"
+        "    return True",
+        # '*'-width takes the pad width from the args, not the format string
+        "len('%*d' % (10**11, 1)) > 0",
+    ],
+)
+def test_runtime_alloc_guards(condition):
+    t0 = time.time()
+    with pytest.raises(ConditionBudgetExceeded):
+        condition_matches(condition, REQ)
+    assert time.time() - t0 < 10
+
+
+@pytest.mark.parametrize(
+    "condition,expected",
+    [
+        ("'%s-%d' % ('a', 1) == 'a-1'", True),
+        ("'a,b'.replace(',', ';') == 'a;b'", True),
+        ("'-'.join(['a', 'b']) == 'a-b'", True),
+        ("f'{1:>3}' == '  1'", True),
+        ("7 % 3 == 1", True),
+        (
+            "def check(request, target, context):\n"
+            "    s = [1]\n"
+            "    s.extend([2, 3])\n"
+            "    d = {}\n"
+            "    d.update({'a': 1})\n"
+            "    return s == [1, 2, 3] and d == {'a': 1}",
+            True,
+        ),
+        ("sum([1, 2], 3) == 6", True),
+    ],
+)
+def test_guarded_string_ops_preserve_semantics(condition, expected):
+    assert condition_matches(condition, REQ) is expected
+
+
+@pytest.mark.parametrize(
+    "condition,expected",
+    [
+        ("1 + 1 == 2", True),
+        ("2 * 3 == 6", True),
+        ("2 ** 10 == 1024", True),
+        ("1 << 4 == 16", True),
+        ("'ab' + 'cd' == 'abcd'", True),
+        ("'ab' * 2 == 'abab'", True),
+        ("sum(range(100)) == 4950", True),
+        ("sorted([3, 1, 2]) == [1, 2, 3]", True),
+        ("min([3, 1, 2]) == 1 and max(3, 1, 2) == 3", True),
+        ("dict(zip(['a'], ['b'])) == {'a': 'b'}", True),
+        (
+            "def check(request, target, context):\n"
+            "    n = 1\n"
+            "    n *= 8\n"
+            "    return n == 8",
+            True,
+        ),
+    ],
+)
+def test_guarded_ops_preserve_semantics(condition, expected):
+    assert condition_matches(condition, REQ) is expected
 
 
 @pytest.mark.parametrize(

@@ -412,3 +412,42 @@ class TestAdapterContextQuery:
             assert w.service.is_allowed(request).decision == Decision.PERMIT
         finally:
             w.stop()
+
+
+class TestConcurrentMutationServing:
+    """Policy mutation must never disturb in-flight serving: the tree swap
+    is atomic, so every concurrent decision is either old-tree or new-tree
+    valid, never an error or a transient of a half-built tree."""
+
+    def test_serving_during_hot_mutation(self):
+        w = Worker().start(seed_cfg())
+        try:
+            request = admin_request()  # super-admin PERMIT under every tree
+            errors: list = []
+            stop = threading.Event()
+
+            def serve():
+                while not stop.is_set():
+                    resp = w.service.is_allowed(admin_request())
+                    if resp.decision != Decision.PERMIT:
+                        errors.append(resp)
+                        return
+
+            threads = [threading.Thread(target=serve) for _ in range(4)]
+            for t in threads:
+                t.start()
+            rules = w.store.get_resource_service("rule")
+            for i in range(10):
+                rules.create([{
+                    "id": f"r_noise_{i}",
+                    "target": {
+                        "subjects": [{"id": URNS["role"], "value": f"x{i}"}],
+                    },
+                    "effect": "DENY",
+                }])
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors[0]
+        finally:
+            w.stop()
