@@ -1,0 +1,438 @@
+"""Property-level matcher matrix: isAllowed decisions and whatIsAllowed
+reverse queries + masking obligations over property-scoped rules.
+
+Suite-4 analog of the reference (test/properties.spec.ts); the expected
+decisions, filtered-rule sets and obligation contents transcribe the
+reference's asserted outcomes for the equivalent scenarios
+(src/core/accessController.ts:465-654 property matcher,
+:592-640 obligation accumulation, :578-581,644-647 skip-deny-rule).
+"""
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+LOC = "urn:restorecommerce:acs:model:location.Location"
+READ = URNS["read"]
+MODIFY = URNS["modify"]
+EXECUTE = URNS["execute"]
+ENTITY = URNS["entity"]
+MASKED = URNS["maskedProperty"]
+
+LOC_ID = LOC + "#id"
+LOC_NAME = LOC + "#name"
+LOC_DESC = LOC + "#description"
+ORG_ID = ORG + "#id"
+ORG_NAME = ORG + "#name"
+ORG_DESC = ORG + "#description"
+
+
+def member_request(**kwargs):
+    defaults = dict(
+        subject_id="ada",
+        subject_role="member",
+        role_scoping_entity=ORG,
+        role_scoping_instance="Org1",
+        owner_indicatory_entity=ORG,
+        owner_instance="Org1",
+        action_type=READ,
+    )
+    defaults.update(kwargs)
+    return build_request(**defaults)
+
+
+def rule_ids(reverse_query, policy_index=0, set_index=0):
+    return [
+        r.id for r in reverse_query.policy_sets[set_index].policies[policy_index].rules
+    ]
+
+
+def policy_ids(reverse_query, set_index=0):
+    return [p.id for p in reverse_query.policy_sets[set_index].policies]
+
+
+def obligation_pairs(reverse_query):
+    """Flatten obligations to (entity_value, [masked property values])."""
+    out = []
+    for ob in reverse_query.obligations:
+        assert ob.id == ENTITY
+        masked = []
+        for a in ob.attributes:
+            assert a.id == MASKED
+            masked.append(a.value)
+        out.append((ob.value, masked))
+    return out
+
+
+# --------------------------------------------------------------- operations
+
+
+class TestMultipleOperations:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("ops_multi.yml")
+
+    def test_deny_execute_out_of_scope(self, engine):
+        # subject scoped to Org2 with an HR subtree rooted at Org3; the
+        # operations are owned by Org1 -> rule HR check fails, fallback DENY
+        request = member_request(
+            role_scoping_instance="Org2",
+            resource_type=["mutation.opA", "mutation.opB"],
+            resource_id=["mutation.opA", "mutation.opB"],
+            action_type=EXECUTE,
+            owner_instance=["Org1", "Org1"],
+            hierarchical_scopes=[{"id": "Org3", "children": []}],
+        )
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    def test_permit_execute_in_scope(self, engine):
+        # operation matching is sticky across request attributes: opB has no
+        # rule but opA's match carries the request (ref :506-508)
+        request = member_request(
+            resource_type=["mutation.opA", "mutation.opB"],
+            resource_id=["mutation.opA", "mutation.opB"],
+            action_type=EXECUTE,
+            owner_instance=["Org1", "Org1"],
+        )
+        assert engine.is_allowed(request).decision == Decision.PERMIT
+
+
+# ------------------------------------------------- single entity with props
+
+
+class TestIsAllowedSingleEntity:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_single.yml")
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_permit_subset_props(self, engine, action):
+        for props in ([LOC_ID, LOC_NAME], [LOC_ID]):
+            request = member_request(
+                resource_type=LOC, resource_id="L1",
+                resource_property=props, action_type=action,
+            )
+            assert engine.is_allowed(request).decision == Decision.PERMIT
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_deny_extra_prop(self, engine, action):
+        request = member_request(
+            resource_type=LOC, resource_id="L1",
+            resource_property=[LOC_ID, LOC_NAME, LOC_DESC], action_type=action,
+        )
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_deny_no_props_in_request(self, engine, action):
+        # rule enumerates properties, request names none -> cannot prove the
+        # subset relationship -> fallback DENY
+        request = member_request(
+            resource_type=LOC, resource_id="L1", action_type=action,
+        )
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+
+class TestWhatIsAllowedSingleEntity:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_single.yml")
+
+    def what(self, engine, **kwargs):
+        kwargs.setdefault("role_scoping_instance", "SuperOrg1")
+        return engine.what_is_allowed(member_request(**kwargs))
+
+    def assert_location_tree(self, rq):
+        """The Location policy survives with the read rule + fallback; the
+        Organization policy (entity-targeted) is filtered out."""
+        assert len(rq.policy_sets) == 1
+        assert policy_ids(rq) == ["pol_location"]
+        assert rule_ids(rq) == ["r_loc_read", "r_loc_fallback"]
+        rule = rq.policy_sets[0].policies[0].rules[0]
+        assert [a.value for a in rule.target.subjects] == ["member", ORG]
+        assert [a.value for a in rule.target.resources] == [LOC, LOC_ID, LOC_NAME]
+        assert [a.value for a in rule.target.actions] == [READ]
+
+    def test_empty_obligation_subset_props(self, engine):
+        for props in ([LOC_ID, LOC_NAME], [LOC_NAME]):
+            rq = self.what(
+                engine, resource_type=LOC, resource_id="L1",
+                resource_property=props,
+            )
+            self.assert_location_tree(rq)
+            assert rq.obligations == []
+
+    def test_obligation_for_extra_prop(self, engine):
+        rq = self.what(
+            engine, resource_type=LOC, resource_id="L1",
+            resource_property=[LOC_ID, LOC_NAME, LOC_DESC],
+        )
+        self.assert_location_tree(rq)
+        pairs = obligation_pairs(rq)
+        assert len(pairs) == 1
+        assert pairs[0][0] == LOC
+        assert pairs[0][1][0] == LOC_DESC
+
+    def test_only_deny_rule_without_props(self, engine):
+        rq = self.what(engine, resource_type=LOC, resource_id="L1")
+        assert len(rq.policy_sets) == 1
+        assert policy_ids(rq) == ["pol_location"]
+        assert rule_ids(rq) == ["r_loc_fallback"]
+        assert rq.policy_sets[0].policies[0].rules[0].effect == "DENY"
+        assert rq.obligations == []
+
+
+# --------------------------------------------- rules without property attrs
+
+
+class TestRulesWithoutProperties:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_rules_noprop.yml")
+
+    def test_is_allowed_any_props(self, engine):
+        for props in ([LOC_ID, LOC_NAME], None):
+            request = member_request(
+                resource_type=LOC, resource_id="L1", resource_property=props,
+            )
+            assert engine.is_allowed(request).decision == Decision.PERMIT
+
+    def test_what_is_allowed_never_masks(self, engine):
+        for props in ([LOC_ID, LOC_NAME], None):
+            rq = engine.what_is_allowed(
+                member_request(
+                    role_scoping_instance="SuperOrg1",
+                    resource_type=LOC, resource_id="L1",
+                    resource_property=props,
+                )
+            )
+            assert rule_ids(rq) == ["r_loc_read", "r_loc_fallback"]
+            rule = rq.policy_sets[0].policies[0].rules[0]
+            assert [a.value for a in rule.target.resources] == [LOC]
+            assert rq.obligations == []
+
+
+# ----------------------------------------- permit-all + deny-one-prop pairs
+
+
+class TestIsAllowedMaskRules:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_multi_rules.yml")
+
+    def test_deny_when_denied_prop_requested(self, engine):
+        for props in ([LOC_ID, LOC_NAME, LOC_DESC], [LOC_DESC]):
+            request = member_request(
+                resource_type=LOC, resource_id="L1", resource_property=props,
+            )
+            assert engine.is_allowed(request).decision == Decision.DENY
+
+    def test_permit_when_denied_prop_absent(self, engine):
+        request = member_request(
+            resource_type=LOC, resource_id="L1",
+            resource_property=[LOC_ID, LOC_NAME],
+        )
+        assert engine.is_allowed(request).decision == Decision.PERMIT
+
+    def test_deny_without_props(self, engine):
+        # no request properties -> the DENY rule cannot be ruled out
+        request = member_request(resource_type=LOC, resource_id="L1")
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_supervisor_unrestricted(self, engine, action):
+        for props in ([LOC_ID, LOC_NAME, LOC_DESC], None):
+            request = member_request(
+                subject_role="supervisor",
+                resource_type=LOC, resource_id="L1",
+                resource_property=props, action_type=action,
+            )
+            assert engine.is_allowed(request).decision == Decision.PERMIT
+
+
+class TestWhatIsAllowedMaskRules:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_multi_rules.yml")
+
+    def what(self, engine, **kwargs):
+        kwargs.setdefault("role_scoping_instance", "SuperOrg1")
+        return engine.what_is_allowed(member_request(**kwargs))
+
+    def test_obligation_when_denied_prop_requested(self, engine):
+        for props in ([LOC_ID, LOC_NAME, LOC_DESC], [LOC_DESC]):
+            rq = self.what(
+                engine, resource_type=LOC, resource_id="L1",
+                resource_property=props,
+            )
+            assert rule_ids(rq) == ["r_read_all", "r_read_deny_desc"]
+            pairs = obligation_pairs(rq)
+            assert len(pairs) == 1
+            assert pairs[0][0] == LOC
+            assert pairs[0][1][0] == LOC_DESC
+
+    def test_no_obligation_for_allowed_props(self, engine):
+        rq = self.what(
+            engine, resource_type=LOC, resource_id="L1",
+            resource_property=[LOC_ID, LOC_NAME],
+        )
+        assert rule_ids(rq) == ["r_read_all", "r_read_deny_desc"]
+        assert rq.obligations == []
+
+    def test_obligation_without_request_props(self, engine):
+        # masked property comes from the DENY rule's own property attribute
+        rq = self.what(engine, resource_type=LOC, resource_id="L1")
+        assert rule_ids(rq) == ["r_read_all", "r_read_deny_desc"]
+        pairs = obligation_pairs(rq)
+        assert len(pairs) == 1
+        assert pairs[0][0] == LOC
+        assert pairs[0][1][0] == LOC_DESC
+
+    def test_supervisor_no_obligations(self, engine):
+        for props in ([LOC_ID, LOC_NAME, LOC_DESC], None):
+            rq = self.what(
+                engine, subject_role="supervisor",
+                resource_type=LOC, resource_id="L1", resource_property=props,
+            )
+            assert rule_ids(rq) == ["r_read_super"]
+            assert rq.obligations == []
+
+
+# -------------------------------------------------------- multiple entities
+
+
+def multi_entity_request(loc_props=None, org_props=None, **kwargs):
+    props = []
+    if loc_props or org_props:
+        props = [loc_props or [], org_props or []]
+    defaults = dict(
+        resource_type=[LOC, ORG],
+        resource_id=["L1", "O1"],
+        resource_property=props or None,
+        owner_instance=["Org1", "Org1"],
+    )
+    defaults.update(kwargs)
+    return member_request(**defaults)
+
+
+class TestIsAllowedMultipleEntities:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_single.yml")
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_permit_subset_props_both_entities(self, engine, action):
+        for loc_props, org_props in (
+            ([LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME]),
+            ([LOC_ID], [ORG_ID]),
+        ):
+            request = multi_entity_request(loc_props, org_props, action_type=action)
+            assert engine.is_allowed(request).decision == Decision.PERMIT
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_deny_extra_prop_on_one_entity(self, engine, action):
+        request = multi_entity_request(
+            [LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME, ORG_DESC], action_type=action,
+        )
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    @pytest.mark.parametrize("action", [READ, MODIFY])
+    def test_deny_without_props(self, engine, action):
+        request = multi_entity_request(action_type=action)
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+
+class TestWhatIsAllowedMultipleEntities:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_single.yml")
+
+    def assert_both_policies(self, rq):
+        assert policy_ids(rq) == ["pol_location", "pol_organization"]
+        assert rule_ids(rq, 0) == ["r_loc_read", "r_loc_fallback"]
+        assert rule_ids(rq, 1) == ["r_org_read", "r_org_fallback"]
+
+    def test_empty_obligations_subset_props(self, engine):
+        for loc_props, org_props in (
+            ([LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME]),
+            ([LOC_ID], [ORG_ID]),
+        ):
+            rq = engine.what_is_allowed(
+                multi_entity_request(loc_props, org_props)
+            )
+            self.assert_both_policies(rq)
+            assert rq.obligations == []
+
+    def test_obligations_per_entity(self, engine):
+        rq = engine.what_is_allowed(
+            multi_entity_request(
+                [LOC_ID, LOC_NAME, LOC_DESC], [ORG_ID, ORG_NAME, ORG_DESC]
+            )
+        )
+        self.assert_both_policies(rq)
+        pairs = obligation_pairs(rq)
+        assert len(pairs) == 2
+        assert pairs[0][0] == LOC and pairs[0][1][0] == LOC_DESC
+        assert pairs[1][0] == ORG and pairs[1][1][0] == ORG_DESC
+
+    def test_only_deny_rules_without_props(self, engine):
+        rq = engine.what_is_allowed(multi_entity_request())
+        assert policy_ids(rq) == ["pol_location", "pol_organization"]
+        assert rule_ids(rq, 0) == ["r_loc_fallback"]
+        assert rule_ids(rq, 1) == ["r_org_fallback"]
+        assert rq.obligations == []
+
+
+# --------------------------------- multiple entities with permit+deny pairs
+
+
+class TestMultiEntityMaskRules:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("props_multi_rules_entities.yml")
+
+    def test_is_allowed_permit_without_denied_props(self, engine):
+        request = multi_entity_request([LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME])
+        assert engine.is_allowed(request).decision == Decision.PERMIT
+
+    def test_is_allowed_deny_with_denied_prop(self, engine):
+        request = multi_entity_request(
+            [LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME, ORG_DESC]
+        )
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    def test_is_allowed_deny_without_props(self, engine):
+        request = multi_entity_request()
+        assert engine.is_allowed(request).decision == Decision.DENY
+
+    def test_what_is_allowed_empty_obligation(self, engine):
+        rq = engine.what_is_allowed(
+            multi_entity_request([LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME])
+        )
+        assert rule_ids(rq, 0) == ["r_loc_all", "r_loc_deny_desc"]
+        assert rule_ids(rq, 1) == ["r_org_all", "r_org_deny_desc"]
+        assert rq.obligations == []
+
+    def test_what_is_allowed_one_entity_obligation(self, engine):
+        rq = engine.what_is_allowed(
+            multi_entity_request([LOC_ID, LOC_NAME], [ORG_ID, ORG_NAME, ORG_DESC])
+        )
+        assert rule_ids(rq, 0) == ["r_loc_all", "r_loc_deny_desc"]
+        assert rule_ids(rq, 1) == ["r_org_all", "r_org_deny_desc"]
+        pairs = obligation_pairs(rq)
+        assert len(pairs) == 1
+        assert pairs[0][0] == ORG and pairs[0][1][0] == ORG_DESC
+
+    def test_what_is_allowed_obligations_without_props(self, engine):
+        # subject may read everything except the two denied properties;
+        # with no properties in the request both DENY rules mask their own
+        # property attribute
+        rq = engine.what_is_allowed(multi_entity_request())
+        assert rule_ids(rq, 0) == ["r_loc_all", "r_loc_deny_desc"]
+        assert rule_ids(rq, 1) == ["r_org_all", "r_org_deny_desc"]
+        pairs = obligation_pairs(rq)
+        assert len(pairs) == 2
+        assert pairs[0][0] == LOC and pairs[0][1][0] == LOC_DESC
+        assert pairs[1][0] == ORG and pairs[1][1][0] == ORG_DESC
