@@ -1,0 +1,152 @@
+"""ACL matrix golden tests (behavioral contract of reference
+test/acl.spec.ts:87-410 against test/fixtures/acl_policies.yml):
+create / modify / delete / read with ACL instances vs HR scopes,
+subject-ID ACLs, and mixed org+user ACL entities.
+
+The subject's HR scope tree is always SuperOrg1 -> Org1 -> Org2 -> Org3
+(tests/utils.build_request default, mirroring reference test/utils.ts).
+"""
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+BUCKET = "urn:restorecommerce:acs:model:bucket.Bucket"
+CREATE = URNS["create"]
+MODIFY = URNS["modify"]
+DELETE = URNS["delete"]
+READ = URNS["read"]
+
+
+def check(engine, expected, **kwargs):
+    defaults = dict(
+        subject_id="Alice",
+        subject_role="Admin",
+        role_scoping_entity=ORG,
+        resource_type=BUCKET,
+        resource_id="test",
+        owner_indicatory_entity=ORG,
+    )
+    defaults.update(kwargs)
+    request = build_request(**defaults)
+    response = engine.is_allowed(request)
+    assert response.decision == expected, kwargs
+    return response
+
+
+class TestACL:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return make_engine("acl_policies.yml")
+
+    # --- create (every ACL instance must be inside subject HR scopes;
+    #     reference acl.spec.ts:110-215) ---
+
+    def test_permit_create_valid_acl_instances(self, engine):
+        check(engine, Decision.PERMIT, action_type=CREATE,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              acl_indicatory_entity=ORG,
+              acl_instances=["Org1", "Org2", "Org3"])
+
+    def test_deny_create_invalid_acl_instances(self, engine):
+        # Org4 is not in the subject's HR tree
+        check(engine, Decision.DENY, action_type=CREATE,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              acl_indicatory_entity=ORG,
+              acl_instances=["Org1", "Org4"])
+
+    def test_permit_create_subject_id_acl(self, engine):
+        # user.User ACL entities are exempt from HR validation on create
+        check(engine, Decision.PERMIT, action_type=CREATE,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              acl_indicatory_entity=USER,
+              acl_instances=["SubjectID1", "SubjectID2"])
+
+    def test_permit_create_mixed_acl_valid_orgs(self, engine):
+        check(engine, Decision.PERMIT, action_type=CREATE,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org2", "Org3"],
+              subject_instances=["SubjectID1", "SubjectID2"])
+
+    def test_deny_create_mixed_acl_invalid_orgs(self, engine):
+        check(engine, Decision.DENY, action_type=CREATE,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org4"],
+              subject_instances=["SubjectID1", "SubjectID2"])
+
+    # --- modify (>=1 subject scope or subject id must appear in the ACL;
+    #     reference acl.spec.ts:217-279) ---
+
+    def test_permit_modify_reduced_valid_acl(self, engine):
+        check(engine, Decision.PERMIT, action_type=MODIFY,
+              role_scoping_instance="Org1", owner_instance="Org1",
+              acl_indicatory_entity=ORG, acl_instances=["Org1"])
+
+    def test_permit_modify_subject_id_in_acl(self, engine):
+        # role scoped to Org4 (outside ACL orgs) but Alice appears in the
+        # user-entity ACL
+        check(engine, Decision.PERMIT, action_type=MODIFY,
+              role_scoping_instance="Org4", owner_instance="Org4",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org2"],
+              subject_instances=["SubjectID1", "Alice"])
+
+    def test_deny_modify_invalid_acl(self, engine):
+        # ACL contains Org4 which is outside the subject's HR scopes and
+        # SuperOrg1 (the subject scope) is not in the ACL
+        check(engine, Decision.DENY, action_type=MODIFY,
+              role_scoping_instance="SuperOrg1", owner_instance="SuperOrg1",
+              acl_indicatory_entity=ORG, acl_instances=["Org1", "Org4"])
+
+    # --- delete (same subject-scope rule as modify;
+    #     reference acl.spec.ts:281-344) ---
+
+    def test_permit_delete_valid_acl(self, engine):
+        check(engine, Decision.PERMIT, action_type=DELETE,
+              role_scoping_instance="Org1", owner_instance="Org1",
+              acl_indicatory_entity=ORG, acl_instances=["Org1", "Org2"])
+
+    def test_permit_delete_subject_id_in_acl(self, engine):
+        check(engine, Decision.PERMIT, action_type=DELETE,
+              role_scoping_instance="Org4", owner_instance="Org4",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org2"],
+              subject_instances=["SubjectID1", "Alice"])
+
+    def test_deny_delete_no_scope_or_subject_in_acl(self, engine):
+        check(engine, Decision.DENY, action_type=DELETE,
+              role_scoping_instance="Org4", owner_instance="Org4",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org2"],
+              subject_instances=["SubjectID1"])
+
+    # --- read by the unscoped SimpleUser rule
+    #     (reference acl.spec.ts:346-408) ---
+
+    def test_permit_read_simple_user_valid_acl(self, engine):
+        check(engine, Decision.PERMIT, action_type=READ,
+              subject_role="SimpleUser",
+              role_scoping_instance="Org1", owner_instance="Org1",
+              acl_indicatory_entity=ORG,
+              acl_instances=["Org1", "Org2", "Org3"])
+
+    def test_permit_read_simple_user_subject_id_in_acl(self, engine):
+        check(engine, Decision.PERMIT, action_type=READ,
+              subject_role="SimpleUser",
+              role_scoping_instance="Org4", owner_instance="Org4",
+              multiple_acl_indicatory_entity=[ORG, USER],
+              org_instances=["Org1", "Org2"],
+              subject_instances=["SubjectID1", "Alice"])
+
+    def test_deny_read_simple_user_scope_not_in_acl(self, engine):
+        check(engine, Decision.DENY, action_type=READ,
+              subject_role="SimpleUser",
+              role_scoping_instance="Org4", owner_instance="Org1",
+              acl_indicatory_entity=ORG,
+              acl_instances=["Org1", "Org2", "Org3"])

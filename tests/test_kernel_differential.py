@@ -27,16 +27,18 @@ USER = "urn:restorecommerce:acs:model:user.User"
 ADDR = "urn:restorecommerce:acs:model:address.Address"
 LOC = "urn:restorecommerce:acs:model:location.Location"
 WIDGET = "urn:restorecommerce:acs:model:widget.Widget"
+BUCKET = "urn:restorecommerce:acs:model:bucket.Bucket"
 
 DEC_CODE = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
 
-SUBJECTS = ["ada", "ben", "gil", "dee", "eva", "kai", "zoe"]
-ROLES = ["member", "manager", "guest"]
-ENTITIES = [ORG, USER, ADDR, LOC, WIDGET]
+SUBJECTS = ["ada", "ben", "gil", "dee", "eva", "kai", "zoe", "Alice"]
+ROLES = ["member", "manager", "guest", "Admin", "SimpleUser", "supervisor"]
+ENTITIES = [ORG, USER, ADDR, LOC, WIDGET, BUCKET]
 ACTIONS = [URNS["read"], URNS["modify"], URNS["create"], URNS["delete"],
            URNS["execute"]]
 PROPS = [ORG + "#name", ORG + "#secret_field", USER + "#name",
-         USER + "#password", ADDR + "#street", LOC + "#address"]
+         USER + "#password", ADDR + "#street", LOC + "#address",
+         LOC + "#id", LOC + "#description", ORG + "#id", ORG + "#description"]
 OWNERS = ["Org1", "Org2", "Org3", "Org4", "SuperOrg1", "otherOrg"]
 
 
@@ -96,9 +98,23 @@ def grid_requests(n=None, seed=7):
                 if multi
                 else rng.choice(OWNERS)
             )
+        subject = rng.choice(SUBJECTS)
+        acl_kwargs = {}
+        roll = rng.random()
+        if roll < 0.2:
+            acl_kwargs = dict(
+                acl_indicatory_entity=rng.choice([ORG, USER]),
+                acl_instances=rng.sample(OWNERS + SUBJECTS, rng.randint(1, 3)),
+            )
+        elif roll < 0.35:
+            acl_kwargs = dict(
+                multiple_acl_indicatory_entity=[ORG, USER],
+                org_instances=rng.sample(OWNERS, rng.randint(1, 3)),
+                subject_instances=rng.sample([subject] + SUBJECTS, 2),
+            )
         out.append(
             build_request(
-                subject_id=rng.choice(SUBJECTS),
+                subject_id=subject,
                 subject_role=rng.choice(ROLES),
                 role_scoping_entity=ORG,
                 role_scoping_instance=rng.choice(OWNERS),
@@ -108,6 +124,7 @@ def grid_requests(n=None, seed=7):
                 action_type=action,
                 owner_indicatory_entity=owner_ent,
                 owner_instance=owner,
+                **acl_kwargs,
             )
         )
     return out
@@ -122,6 +139,12 @@ def grid_requests(n=None, seed=7):
         "role_scopes.yml",
         "hr_disabled.yml",
         "conditions.yml",
+        "acl_policies.yml",
+        "props_single.yml",
+        "props_rules_noprop.yml",
+        "props_multi_rules.yml",
+        "props_multi_rules_entities.yml",
+        "ops_multi.yml",
     ],
 )
 def test_fixture_differential(fixture_name):

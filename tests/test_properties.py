@@ -173,7 +173,7 @@ class TestWhatIsAllowedSingleEntity:
         pairs = obligation_pairs(rq)
         assert len(pairs) == 1
         assert pairs[0][0] == LOC
-        assert pairs[0][1][0] == LOC_DESC
+        assert pairs[0][1] == [LOC_DESC]
 
     def test_only_deny_rule_without_props(self, engine):
         rq = self.what(engine, resource_type=LOC, resource_id="L1")
@@ -271,7 +271,7 @@ class TestWhatIsAllowedMaskRules:
             pairs = obligation_pairs(rq)
             assert len(pairs) == 1
             assert pairs[0][0] == LOC
-            assert pairs[0][1][0] == LOC_DESC
+            assert pairs[0][1] == [LOC_DESC]
 
     def test_no_obligation_for_allowed_props(self, engine):
         rq = self.what(
@@ -282,13 +282,17 @@ class TestWhatIsAllowedMaskRules:
         assert rq.obligations == []
 
     def test_obligation_without_request_props(self, engine):
-        # masked property comes from the DENY rule's own property attribute
+        # masked property comes from the DENY rule's own property attribute;
+        # it is pushed once per request attribute (entity + resourceID = 2)
+        # because with no request properties the reference's mask branch
+        # fires on every attribute iteration
+        # (reference: accessController.ts:622-640)
         rq = self.what(engine, resource_type=LOC, resource_id="L1")
         assert rule_ids(rq) == ["r_read_all", "r_read_deny_desc"]
         pairs = obligation_pairs(rq)
         assert len(pairs) == 1
         assert pairs[0][0] == LOC
-        assert pairs[0][1][0] == LOC_DESC
+        assert pairs[0][1] == [LOC_DESC] * 2
 
     def test_supervisor_no_obligations(self, engine):
         for props in ([LOC_ID, LOC_NAME, LOC_DESC], None):
@@ -374,8 +378,8 @@ class TestWhatIsAllowedMultipleEntities:
         self.assert_both_policies(rq)
         pairs = obligation_pairs(rq)
         assert len(pairs) == 2
-        assert pairs[0][0] == LOC and pairs[0][1][0] == LOC_DESC
-        assert pairs[1][0] == ORG and pairs[1][1][0] == ORG_DESC
+        assert pairs[0][0] == LOC and pairs[0][1] == [LOC_DESC]
+        assert pairs[1][0] == ORG and pairs[1][1] == [ORG_DESC]
 
     def test_only_deny_rules_without_props(self, engine):
         rq = engine.what_is_allowed(multi_entity_request())
@@ -423,7 +427,7 @@ class TestMultiEntityMaskRules:
         assert rule_ids(rq, 1) == ["r_org_all", "r_org_deny_desc"]
         pairs = obligation_pairs(rq)
         assert len(pairs) == 1
-        assert pairs[0][0] == ORG and pairs[0][1][0] == ORG_DESC
+        assert pairs[0][0] == ORG and pairs[0][1] == [ORG_DESC]
 
     def test_what_is_allowed_obligations_without_props(self, engine):
         # subject may read everything except the two denied properties;
@@ -434,5 +438,10 @@ class TestMultiEntityMaskRules:
         assert rule_ids(rq, 1) == ["r_org_all", "r_org_deny_desc"]
         pairs = obligation_pairs(rq)
         assert len(pairs) == 2
-        assert pairs[0][0] == LOC and pairs[0][1][0] == LOC_DESC
-        assert pairs[1][0] == ORG and pairs[1][1][0] == ORG_DESC
+        # duplicate counts mirror the reference's per-request-attribute mask
+        # pushes with sticky entityMatch: the Location deny rule fires on all
+        # 4 request attributes (entityMatch stays true after the Location
+        # entity matched), the Organization rule only on its own 2
+        # (reference: accessController.ts:493,622-640)
+        assert pairs[0][0] == LOC and pairs[0][1] == [LOC_DESC] * 4
+        assert pairs[1][0] == ORG and pairs[1][1] == [ORG_DESC] * 2
