@@ -101,15 +101,16 @@ class Worker:
             async_compile=bool(cfg.get("evaluator:async_compile", False)),
         )
 
-        # policy store with self-authorization hook
+        # policy store with self-authorization hook; the hook consults the
+        # live config so authorization:enabled can be toggled at runtime via
+        # config_update (reference: tests drive cfg.set + updateConfig,
+        # test/microservice_acs_enabled.spec.ts:379-382)
         self.store = PolicyStore(
             self.engine,
             evaluator=self.evaluator,
             bus=self.bus,
             snapshot_dir=cfg.get("database:snapshot_dir"),
-            access_check=self._access_check
-            if cfg.get("authorization:enabled")
-            else None,
+            access_check=self._access_check,
             logger=self.logger,
         )
 
@@ -205,8 +206,13 @@ class Worker:
     def _access_check(self, kind, items, action, subject, ctx):
         """The service authorizes its own policy CRUD by asking itself
         (reference: checkAccessRequest -> gRPC back into this service's
-        isAllowed, src/core/utils.ts:212-261, cfg client.acs-srv = self)."""
+        isAllowed, src/core/utils.ts:212-261, cfg client.acs-srv = self).
+        A disabled authorization config short-circuits to PERMIT
+        (reference: utils.ts:216-219)."""
         from ..models.model import Attribute, Request, Target
+
+        if not self.cfg.get("authorization:enabled"):
+            return Decision.PERMIT
 
         urns = self.engine.urns
         action_urn = {

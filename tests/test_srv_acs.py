@@ -1,0 +1,365 @@
+"""Self-authorization integration grid (behavioral contract of the
+reference's test/microservice_acs_enabled.spec.ts): the service authorizes
+its own policy CRUD by evaluating against the default_policies fixture,
+subjects are resolved from tokens through a mock identity service, and
+hierarchical scopes arrive through the HR-scope rendezvous loopback
+(request out on the auth topic, test responder emits the response back —
+the reference's no-cluster multi-node test pattern, spec.ts:286-322).
+
+Covers: runtime authorization toggle, create/update/upsert/delete with
+valid and invalid subject scopes (exact 403 message text,
+e.g. spec.ts:613-617), multi-owner items, invalid-owner DENY, and
+multiple scoping instances assigned to the same role (spec.ts:879-1075).
+"""
+
+import threading
+
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+
+from .utils import URNS, fixture, marshall_yaml_policies
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+TEST_ENTITY = "urn:restorecommerce:acs:model:test.Test"
+SUBJECT_ID_URN = "urn:oasis:names:tc:xacml:1.0:subject:subject-id"
+
+HR_TREE = [
+    {
+        "id": "mainOrg",
+        "role": "admin-r-id",
+        "children": [
+            {"id": "orgA",
+             "children": [{"id": "orgB", "children": [{"id": "orgC"}]}]}
+        ],
+    }
+]
+
+
+def role_associations(role, instances=("mainOrg",)):
+    return [
+        {
+            "role": role,
+            "attributes": [
+                {
+                    "id": URNS["roleScopingEntity"],
+                    "value": ORG,
+                    "attributes": [
+                        {"id": URNS["roleScopingInstance"], "value": inst}
+                        for inst in instances
+                    ],
+                }
+            ],
+        }
+    ]
+
+
+def owners(*instances):
+    return [
+        {
+            "id": URNS["ownerIndicatoryEntity"],
+            "value": ORG,
+            "attributes": [
+                {"id": URNS["ownerInstance"], "value": inst}
+                for inst in instances
+            ],
+        }
+    ]
+
+
+def make_rule(rule_id="test_rule_id", name="test rule for test entity",
+              owner_instances=("orgC",)):
+    return {
+        "id": rule_id,
+        "name": name,
+        "description": "test rule",
+        "target": {
+            "subjects": [{"id": SUBJECT_ID_URN, "value": "test-r-id"}],
+            "resources": [{"id": URNS["entity"], "value": TEST_ENTITY}],
+        },
+        "effect": "PERMIT",
+        "meta": {"owners": owners(*owner_instances)},
+    }
+
+
+def denied_message(subject_id, resource, action, scope):
+    """(reference: resourceManager 403 text, spec.ts:613-617)"""
+    return (
+        f"Access not allowed for request with subject:{subject_id}, "
+        f"resource:{resource}, action:{action}, target_scope:{scope}; "
+        f"the response was DENY"
+    )
+
+
+@pytest.fixture(scope="class")
+def rig():
+    w = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "authorization": {
+                "enabled": False,
+                "enforce": False,
+                "hrReqTimeout": 2000,
+            },
+        }
+    )
+    # mock identity service (reference: grpc-mock-server findByToken,
+    # spec.ts:106-223)
+    w.identity_client.register(
+        "admin_token",
+        {
+            "id": "admin_user_id",
+            "tokens": [{"token": "admin_token"}],
+            "role_associations": role_associations("admin-r-id"),
+        },
+    )
+    w.identity_client.register(
+        "user_token",
+        {
+            "id": "user_id",
+            "tokens": [{"token": "user_token"}],
+            "role_associations": role_associations("user-r-id"),
+        },
+    )
+
+    # HR-scope rendezvous loopback responder (spec.ts:286-322)
+    auth_topic = w.bus.topic("io.restorecommerce.authentication")
+
+    def responder(event_name, message, ctx):
+        if event_name != "hierarchicalScopesRequest":
+            return
+        token_date = message["token"]
+        token = token_date.split(":")[0]
+        subject_id = {"admin_token": "admin_user_id",
+                      "user_token": "user_id"}.get(token)
+        if subject_id is None:
+            return
+
+        def reply():
+            auth_topic.emit(
+                "hierarchicalScopesResponse",
+                {
+                    "token": token_date,
+                    "subject_id": subject_id,
+                    "hierarchical_scopes": HR_TREE,
+                },
+            )
+
+        threading.Thread(target=reply, daemon=True).start()
+
+    auth_topic.on(responder)
+    yield w
+    w.stop()
+
+
+def admin_subject(scope=None):
+    subject = {"id": "admin_user_id", "token": "admin_token"}
+    if scope:
+        subject["scope"] = scope
+    return subject
+
+
+def user_subject(scope=None):
+    subject = {"id": "user_id", "token": "user_token"}
+    if scope:
+        subject["scope"] = scope
+    return subject
+
+
+class TestSelfAuthorizedCrudGrid:
+    """Tests run in definition order against one worker, mirroring the
+    reference suite's stateful progression."""
+
+    def test_insert_defaults_acs_disabled(self, rig):
+        policy_sets, policies, rules = marshall_yaml_policies(
+            fixture("default_policies.yml")
+        )
+        ps_srv = rig.store.get_resource_service("policy_set")
+        pol_srv = rig.store.get_resource_service("policy")
+        rule_srv = rig.store.get_resource_service("rule")
+        result = ps_srv.create(policy_sets, subject=admin_subject())
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert len(result["items"]) == len(policy_sets)
+        result = pol_srv.create(policies, subject=admin_subject())
+        assert result["operation_status"]["code"] == 200
+        assert len(result["items"]) == len(policies)
+        result = rule_srv.create(rules, subject=admin_subject())
+        assert result["operation_status"]["code"] == 200
+        assert len(result["items"]) == len(rules)
+        assert "PS1" in rig.engine.policy_sets
+
+    def test_create_rule_valid_scope(self, rig):
+        # runtime toggle (reference: cfg.set + updateConfig, spec.ts:379-382)
+        rig.command_interface.command(
+            "config_update",
+            {"authorization:enabled": True, "authorization:enforce": True},
+        )
+        result = rig.store.get_resource_service("rule").create(
+            [make_rule()], subject=admin_subject(scope="orgC")
+        )
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert len(result["items"]) == 1
+
+    def test_create_rule_without_scope(self, rig):
+        result = rig.store.get_resource_service("rule").create(
+            [make_rule(rule_id="test_rule_id2")], subject=admin_subject()
+        )
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert len(result["items"]) == 1
+
+    def test_create_two_multi_owner_rules_and_delete(self, rig):
+        rules = rig.store.get_resource_service("rule")
+        items = [
+            make_rule(rule_id="", name="1 test rule", owner_instances=("orgA",)),
+            make_rule(rule_id="", name="2 test rule", owner_instances=("orgB",)),
+        ]
+        for item in items:
+            del item["id"]
+        result = rules.create(items, subject=admin_subject(scope="mainOrg"))
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert len(result["items"]) == 2
+        ids = [entry["payload"]["id"] for entry in result["items"]]
+        deleted = rules.delete(ids=ids, subject=admin_subject(scope="mainOrg"))
+        assert deleted["operation_status"] == {"code": 200, "message": "success"}
+
+    def test_deny_create_invalid_owner(self, rig):
+        items = [
+            make_rule(rule_id="", name="1 test rule", owner_instances=("orgA",)),
+            # INVALID is not in the subject's HR tree
+            make_rule(rule_id="", name="2 test rule",
+                      owner_instances=("INVALID",)),
+        ]
+        for item in items:
+            del item["id"]
+        result = rig.store.get_resource_service("rule").create(
+            items, subject=admin_subject(scope="orgA")
+        )
+        assert "items" not in result
+        assert result["operation_status"]["code"] == 403
+        assert result["operation_status"]["message"] == denied_message(
+            "admin_user_id", "rule", "CREATE", "orgA"
+        )
+
+    def test_deny_create_user_role(self, rig):
+        result = rig.store.get_resource_service("rule").create(
+            [make_rule(rule_id="test_rule_id3")],
+            subject=user_subject(scope="orgC"),
+        )
+        assert "items" not in result
+        assert result["operation_status"]["code"] == 403
+        assert result["operation_status"]["message"] == denied_message(
+            "user_id", "rule", "CREATE", "orgC"
+        )
+
+    def test_update_valid_scope(self, rig):
+        item = make_rule(name="modified test rule for test entity")
+        result = rig.store.get_resource_service("rule").update(
+            [item], subject=admin_subject(scope="orgC")
+        )
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert result["items"][0]["payload"]["name"] == (
+            "modified test rule for test entity"
+        )
+
+    def test_deny_update_user_role(self, rig):
+        result = rig.store.get_resource_service("rule").update(
+            [make_rule(name="new test rule")],
+            subject=user_subject(scope="orgC"),
+        )
+        assert "items" not in result
+        assert result["operation_status"]["code"] == 403
+        assert result["operation_status"]["message"] == denied_message(
+            "user_id", "rule", "MODIFY", "orgC"
+        )
+
+    def test_upsert_valid_scope(self, rig):
+        item = make_rule(name="upserted test rule for test entity")
+        result = rig.store.get_resource_service("rule").upsert(
+            [item], subject=admin_subject(scope="orgC")
+        )
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert result["items"][0]["payload"]["name"] == (
+            "upserted test rule for test entity"
+        )
+
+    def test_deny_upsert_user_role(self, rig):
+        result = rig.store.get_resource_service("rule").upsert(
+            [make_rule(name="new test rule")],
+            subject=user_subject(scope="orgC"),
+        )
+        assert "items" not in result
+        assert result["operation_status"]["code"] == 403
+        assert result["operation_status"]["message"] == denied_message(
+            "user_id", "rule", "MODIFY", "orgC"
+        )
+
+    def test_deny_delete_user_role(self, rig):
+        result = rig.store.get_resource_service("rule").delete(
+            ids=["test_rule_id"], subject=user_subject(scope="orgC")
+        )
+        assert result["operation_status"]["code"] == 403
+        assert result["operation_status"]["message"] == denied_message(
+            "user_id", "rule", "DELETE", "orgC"
+        )
+        assert rig.store.collections["rule"].get("test_rule_id") is not None
+
+    def test_delete_valid_scope(self, rig):
+        result = rig.store.get_resource_service("rule").delete(
+            ids=["test_rule_id"], subject=admin_subject(scope="orgC")
+        )
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert rig.store.collections["rule"].get("test_rule_id") is None
+
+    def test_multi_instance_role_scoping(self, rig):
+        """Same role assigned two scoping instances; each scope can create
+        rules owned by that scope (spec.ts:879-971)."""
+        subject = {
+            "id": "admin_user_id",
+            "scope": "org1",
+            "role_associations": role_associations(
+                "admin-r-id", instances=("org1", "org2")
+            ),
+            "hierarchical_scopes": [
+                {"id": "org1", "role": "admin-r-id", "children": []},
+                {"id": "org2", "role": "admin-r-id", "children": []},
+            ],
+        }
+        rules = rig.store.get_resource_service("rule")
+        item = make_rule(rule_id="", name="1 test rule",
+                         owner_instances=("org1",))
+        del item["id"]
+        result = rules.create([item], subject=subject)
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert result["items"][0]["payload"]["name"] == "1 test rule"
+
+        subject["scope"] = "org2"
+        item = make_rule(rule_id="", name="2 test rule",
+                         owner_instances=("org2",))
+        del item["id"]
+        result = rules.create([item], subject=subject)
+        assert result["operation_status"] == {"code": 200, "message": "success"}
+        assert result["items"][0]["payload"]["name"] == "2 test rule"
+
+    def test_multi_owner_multi_instance_without_scope(self, rig):
+        """Items owned by several orgs, subject scoped to a subset, no
+        explicit scope in the subject (spec.ts:973-1075)."""
+        subject = {
+            "id": "admin_user_id",
+            "role_associations": role_associations(
+                "admin-r-id", instances=("org1", "org2")
+            ),
+            "hierarchical_scopes": [
+                {"id": "org1", "role": "admin-r-id", "children": []},
+                {"id": "org2", "role": "admin-r-id", "children": []},
+            ],
+        }
+        rules = rig.store.get_resource_service("rule")
+        for name in ("1 test rule", "2 test rule"):
+            item = make_rule(rule_id="", name=name,
+                             owner_instances=("org1", "org2", "org3"))
+            del item["id"]
+            result = rules.create([item], subject=subject)
+            assert result["operation_status"] == {
+                "code": 200, "message": "success",
+            }
+            assert result["items"][0]["payload"]["name"] == name

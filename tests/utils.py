@@ -32,6 +32,33 @@ def make_engine(fixture_name: Optional[str] = None, **kwargs) -> AccessControlle
     return engine
 
 
+def marshall_yaml_policies(path: str):
+    """Flatten a nested fixture YAML into the three flat CRUD payload lists
+    (children referenced by id), the shape the resource services persist
+    (modeled on reference test/utils.ts marshallYamlPolicies:282-309)."""
+    import yaml
+
+    with open(path) as fh:
+        doc = yaml.safe_load(fh)
+    policy_sets, policies, rules = [], [], []
+    for ps in doc.get("policy_sets") or []:
+        ps = dict(ps)
+        child_policies = ps.pop("policies", []) or []
+        ps["policies"] = []
+        for pol in child_policies:
+            pol = dict(pol)
+            child_rules = pol.pop("rules", []) or []
+            pol["rules"] = []
+            for rule in child_rules:
+                rule = dict(rule)
+                pol["rules"].append(rule["id"])
+                rules.append(rule)
+            ps["policies"].append(pol["id"])
+            policies.append(pol)
+        policy_sets.append(ps)
+    return policy_sets, policies, rules
+
+
 def _listify(value) -> list:
     if value is None:
         return []
