@@ -35,6 +35,18 @@ from .interner import ABSENT
 
 BIG = jnp.int32(1 << 30)
 
+# Policy trees whose compiled tensors fit under this size are baked into the
+# jitted program as XLA constants (the compiler pre-folds every
+# policy-dependent subexpression once); larger trees are passed as
+# device-resident arguments, since embedded constants make XLA spend
+# unbounded time constant-folding and are re-embedded per batch bucket.
+CONSTANT_BAKE_LIMIT_BYTES = 1 << 20
+
+
+def bake_policy_constants(compiled: CompiledPolicies) -> bool:
+    policy_bytes = sum(np.asarray(v).nbytes for v in compiled.arrays.values())
+    return policy_bytes <= CONSTANT_BAKE_LIMIT_BYTES
+
 
 def _pairs_subset(rule_ids, rule_vals, req_ids, req_vals):
     """Every valid rule (id, value) pair appears among the request pairs
@@ -463,6 +475,7 @@ class DecisionKernel:
             )
         self.compiled = compiled
         self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+        self._bake_constants = bake_policy_constants(compiled)
 
         def run(c, batch_arrays, rgx_set, pfx_neq, cond_true, cond_abort, cond_code):
             # vmap over the leading batch axis of request arrays; regex
@@ -479,7 +492,11 @@ class DecisionKernel:
                 cond_true.T, cond_abort.T, cond_code.T,
             )
 
-        self._run = jax.jit(partial(run, self._c))
+        if self._bake_constants:
+            self._run = jax.jit(partial(run, self._c))
+        else:
+            self._jit = jax.jit(run)
+            self._run = lambda *args: self._jit(self._c, *args)
 
     def evaluate(self, batch: RequestBatch):
         """Returns (decision, cacheable, status) numpy arrays [B].

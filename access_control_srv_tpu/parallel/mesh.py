@@ -15,6 +15,8 @@ extension for trees too large to replicate per chip.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -22,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.compile import CompiledPolicies
 from ..ops.encode import RequestBatch
-from ..ops.kernel import _evaluate_one
+from ..ops.kernel import _evaluate_one, bake_policy_constants
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
@@ -57,13 +59,10 @@ class ShardedDecisionKernel:
         self.mesh = mesh
         self.axis = axis
         self.n_devices = mesh.devices.size
-        self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
         self._batch_sharding = NamedSharding(mesh, P(axis))
         self._repl = NamedSharding(mesh, P())
 
-        c = self._c
-
-        def run(batch_arrays, rgx_set, pfx_neq):
+        def run(c, batch_arrays, rgx_set, pfx_neq):
             # batch_arrays carries the per-request encodings plus the
             # transposed condition bits (cond_true/abort/code as [B, C])
             def one(ra):
@@ -79,15 +78,32 @@ class ShardedDecisionKernel:
 
             return jax.vmap(one)(batch_arrays)
 
-        self._run = jax.jit(
-            run,
-            in_shardings=(None, self._repl, self._repl),
-            out_shardings=(
-                self._batch_sharding,
-                self._batch_sharding,
-                self._batch_sharding,
-            ),
+        out_shardings = (
+            self._batch_sharding,
+            self._batch_sharding,
+            self._batch_sharding,
         )
+        if bake_policy_constants(compiled):
+            # small tree: bake as constants (see ops.kernel.DecisionKernel)
+            c_const = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+            self._run = jax.jit(
+                partial(run, c_const),
+                in_shardings=(None, self._repl, self._repl),
+                out_shardings=out_shardings,
+            )
+        else:
+            # replicate the policy tensors across the mesh once and pass
+            # them as arguments
+            self._c = {
+                k: jax.device_put(jnp.asarray(v), self._repl)
+                for k, v in compiled.arrays.items()
+            }
+            self._jit = jax.jit(
+                run,
+                in_shardings=(self._repl, None, self._repl, self._repl),
+                out_shardings=out_shardings,
+            )
+            self._run = lambda *args: self._jit(self._c, *args)
 
     def evaluate(self, batch: RequestBatch):
         arrays = dict(batch.arrays)

@@ -1,0 +1,445 @@
+#!/usr/bin/env python
+"""Full benchmark matrix: the five BASELINE.md configs.
+
+Prints one JSON line per config and writes the collected results to
+BENCH_ALL.json.  ``bench.py`` remains the driver's single-line headline
+benchmark (config 2); this file is the evidence matrix:
+
+1. ``scalar-cpu``      — the scalar oracle on the seed policy set, one
+                         request at a time (the reference-architecture CPU
+                         measurement; reference evaluates one request per
+                         gRPC call, src/accessControlService.ts:62-81).
+2. ``tpu-batched``     — batched kernel on the seed policy set (bench.py).
+3. ``what-is-allowed`` — reverse queries over 1k distinct subjects
+                         (host-side path, reference
+                         src/core/accessController.ts:326-427).
+4. ``hr-conditions``   — role-scoped policies with hierarchical owner
+                         matching + condition predicates through the
+                         kernel (fixtures role_scopes/conditions).
+5. ``stress-100k``     — synthetic ~100k-rule tree (nested deny+permit-
+                         overrides), large tiled request batch, chunked
+                         device evaluation.
+
+Environment knobs: BENCH_BATCH (config 2 total), STRESS_RULES,
+STRESS_TOTAL, STRESS_CHUNK, SCALAR_N, WIA_N.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_TARGET = 100_000.0
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+DO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:deny-overrides"
+
+
+def _seed_engine():
+    from access_control_srv_tpu.core import AccessController, load_seed_files
+
+    engine = AccessController()
+    seed = os.path.join(REPO, "data", "seed_data")
+    for ps in load_seed_files(
+        os.path.join(seed, "policy_sets.yaml"),
+        os.path.join(seed, "policies.yaml"),
+        os.path.join(seed, "rules.yaml"),
+    ):
+        engine.update_policy_set(ps)
+    return engine
+
+
+def _result(name, value, unit, extra=None):
+    row = {
+        "metric": name,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / BASELINE_TARGET, 3),
+    }
+    if extra:
+        row.update(extra)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+# ------------------------------------------------------- config 1: scalar CPU
+
+
+def bench_scalar_cpu():
+    engine = _seed_engine()
+    from access_control_srv_tpu.ops import compile_policies
+
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    n = int(os.environ.get("SCALAR_N", 2000))
+    requests = []
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    for i in range(n):
+        role = "superadministrator-r-id" if i % 2 == 0 else f"role-{i % 7}"
+        requests.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=urns["role"], value=role),
+                        Attribute(id=urns["subjectID"], value=f"user-{i % 512}"),
+                    ],
+                    resources=[
+                        Attribute(id=urns["entity"], value=ORG),
+                        Attribute(id=urns["resourceID"], value=f"res-{i}"),
+                    ],
+                    actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"user-{i % 512}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    # warmup
+    for req in requests[:50]:
+        engine.is_allowed(req)
+    t0 = time.perf_counter()
+    for req in requests:
+        engine.is_allowed(req)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        "isAllowed decisions/sec (scalar oracle, CPU, seed policy set)",
+        n / elapsed,
+        "decisions/s",
+        {"n": n, "compiled_supported": bool(compiled.supported)},
+    )
+
+
+# ----------------------------------------------------- config 2: TPU batched
+
+
+def bench_tpu_batched():
+    import io
+    from contextlib import redirect_stdout
+
+    import bench
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    row = json.loads(buf.getvalue().strip().splitlines()[-1])
+    print(json.dumps(row), flush=True)
+    return row
+
+
+# -------------------------------------------------- config 3: whatIsAllowed
+
+
+def bench_what_is_allowed():
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    engine = _seed_engine()
+    urns = Urns()
+    n = int(os.environ.get("WIA_N", 1000))
+    requests = []
+    for i in range(n):
+        role = "superadministrator-r-id" if i % 2 == 0 else f"role-{i % 11}"
+        requests.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=urns["role"], value=role),
+                        Attribute(id=urns["subjectID"], value=f"subject-{i}"),
+                    ],
+                    resources=[Attribute(id=urns["entity"], value=ORG)],
+                    actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"subject-{i}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    for req in requests[:50]:
+        engine.what_is_allowed(req)
+    t0 = time.perf_counter()
+    for req in requests:
+        engine.what_is_allowed(req)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        "whatIsAllowed queries/sec (reverse query, 1k subjects)",
+        n / elapsed,
+        "queries/s",
+        {"n": n},
+    )
+
+
+# ------------------------------------------- config 4: HR scopes + conditions
+
+
+def bench_hr_conditions():
+    import jax
+
+    from access_control_srv_tpu.core import AccessController, populate
+    from access_control_srv_tpu.ops import (
+        DecisionKernel,
+        compile_policies,
+        encode_requests,
+    )
+    from tests.utils import build_request
+
+    engine = AccessController()
+    populate(engine, os.path.join(REPO, "tests", "fixtures", "role_scopes.yml"))
+    populate(engine, os.path.join(REPO, "tests", "fixtures", "conditions.yml"))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    kernel = DecisionKernel(compiled)
+
+    LOC = "urn:restorecommerce:acs:model:location.Location"
+    owners = ["Org1", "Org2", "Org3", "SuperOrg1", "otherOrg"]
+    base = 2048
+    requests = []
+    for i in range(base):
+        requests.append(
+            build_request(
+                subject_id=f"user-{i % 64}",
+                subject_role=["member", "manager", "guest"][i % 3],
+                role_scoping_entity=ORG,
+                role_scoping_instance=owners[i % len(owners)],
+                resource_type=LOC if i % 2 else ORG,
+                resource_id=f"L{i}",
+                action_type=(
+                    "urn:restorecommerce:acs:names:action:read"
+                    if i % 3
+                    else "urn:restorecommerce:acs:names:action:modify"
+                ),
+                owner_indicatory_entity=ORG,
+                owner_instance=owners[(i * 7) % len(owners)],
+            )
+        )
+    batch = encode_requests(requests, compiled)
+    n_eligible = int(batch.eligible.sum())
+    import jax.numpy as jnp
+
+    args = (
+        {k: jnp.asarray(v) for k, v in batch.arrays.items()},
+        jnp.asarray(batch.rgx_set),
+        jnp.asarray(batch.pfx_neq),
+        jnp.asarray(batch.cond_true),
+        jnp.asarray(batch.cond_abort),
+        jnp.asarray(batch.cond_code),
+    )
+    out = kernel._run(*args)
+    jax.block_until_ready(out)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel._run(*args)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        "isAllowed decisions/sec/chip (role scopes + conditions fixtures)",
+        base * iters / elapsed,
+        "decisions/s",
+        {"batch": base, "eligible": n_eligible},
+    )
+
+
+# ------------------------------------------------- config 5: 100k-rule stress
+
+
+def _stress_engine(n_rules: int):
+    """Synthetic tree: deny-overrides set of permit-overrides policies,
+    role/entity/action-targeted rules with interleaved PERMIT/DENY."""
+    from access_control_srv_tpu.core.loader import load_policy_sets
+    from access_control_srv_tpu.core import AccessController
+    from access_control_srv_tpu.models import Urns
+
+    urns = Urns()
+    n_policies = max(1, n_rules // 400)
+    per_policy = n_rules // n_policies
+    entities = [
+        f"urn:restorecommerce:acs:model:stress{k}.Stress{k}" for k in range(64)
+    ]
+    actions = [urns["read"], urns["modify"], urns["create"], urns["delete"]]
+    policies = []
+    rid = 0
+    for p in range(n_policies):
+        rules = []
+        for q in range(per_policy):
+            entity = entities[(p * 31 + q) % len(entities)]
+            rules.append(
+                {
+                    "id": f"r{rid}",
+                    "target": {
+                        "subjects": [
+                            {"id": urns["role"], "value": f"role-{rid % 97}"}
+                        ],
+                        "resources": [{"id": urns["entity"], "value": entity}],
+                        "actions": [
+                            {"id": urns["actionID"],
+                             "value": actions[rid % len(actions)]}
+                        ],
+                    },
+                    "effect": "PERMIT" if rid % 3 else "DENY",
+                }
+            )
+            rid += 1
+        policies.append(
+            {"id": f"p{p}", "combining_algorithm": PO, "rules": rules}
+        )
+    doc = {
+        "policy_sets": [
+            {"id": "stress", "combining_algorithm": DO, "policies": policies}
+        ]
+    }
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    return engine, rid
+
+
+def bench_stress():
+    import jax
+    import jax.numpy as jnp
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.ops import (
+        DecisionKernel,
+        compile_policies,
+        encode_requests,
+    )
+
+    urns = Urns()
+    n_rules = int(os.environ.get("STRESS_RULES", 100_000))
+    total = int(os.environ.get("STRESS_TOTAL", 1 << 17))
+    chunk = int(os.environ.get("STRESS_CHUNK", 1024))
+
+    t0 = time.perf_counter()
+    engine, actual_rules = _stress_engine(n_rules)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    compile_s = time.perf_counter() - t0
+    kernel = DecisionKernel(compiled)
+
+    base = chunk
+    requests = []
+    rng = np.random.default_rng(7)
+    for i in range(base):
+        # rules cover role-{0..96} and stress{0..63}; draw slightly wider so
+        # ~10-20% of requests match nothing (realistic miss traffic) while
+        # the bulk exercises matched-rule evaluation
+        role = f"role-{int(rng.integers(108))}"
+        k = int(rng.integers(72))
+        entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+        requests.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=urns["role"], value=role),
+                        Attribute(id=urns["subjectID"], value=f"u{i}"),
+                    ],
+                    resources=[
+                        Attribute(id=urns["entity"], value=entity),
+                        Attribute(id=urns["resourceID"], value=f"res-{i}"),
+                    ],
+                    actions=[
+                        Attribute(
+                            id=urns["actionID"],
+                            value=[urns["read"], urns["modify"],
+                                   urns["create"], urns["delete"]][i % 4],
+                        )
+                    ],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"u{i}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    batch = encode_requests(requests, compiled)
+    args = (
+        {k: jnp.asarray(v) for k, v in batch.arrays.items()},
+        jnp.asarray(batch.rgx_set),
+        jnp.asarray(batch.pfx_neq),
+        jnp.asarray(batch.cond_true),
+        jnp.asarray(batch.cond_abort),
+        jnp.asarray(batch.cond_code),
+    )
+    out = kernel._run(*args)
+    jax.block_until_ready(out)
+    # sanity: kernel vs oracle on a scalar sample
+    dec = np.asarray(out[0])
+    from access_control_srv_tpu.models import Decision
+
+    code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    for i in range(0, base, max(1, base // 16)):
+        expected = engine.is_allowed(requests[i])
+        assert dec[i] == code[expected.decision], (i, dec[i], expected.decision)
+
+    iters = max(1, total // base)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel._run(*args)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        f"isAllowed decisions/sec/chip ({actual_rules}-rule synthetic stress)",
+        base * iters / elapsed,
+        "decisions/s",
+        {"rules": actual_rules, "batch": base, "iters": iters,
+         "host_compile_s": round(compile_s, 2)},
+    )
+
+
+def main():
+    # BENCH_PLATFORM=cpu forces the CPU backend (the machine pins
+    # JAX_PLATFORMS=axon externally, so the env var alone cannot override
+    # it — jax.config must be set before the first backend touch)
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    which = sys.argv[1:] or ["scalar", "batched", "wia", "hr", "stress"]
+    rows = []
+    fns = {
+        "scalar": bench_scalar_cpu,
+        "batched": bench_tpu_batched,
+        "wia": bench_what_is_allowed,
+        "hr": bench_hr_conditions,
+        "stress": bench_stress,
+    }
+    for name in which:
+        rows.append(fns[name]())
+    # merge by metric name so partial runs refresh their rows without
+    # clobbering the rest of the evidence matrix
+    path = os.path.join(REPO, "BENCH_ALL.json")
+    merged: dict[str, dict] = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            for row in json.load(fh):
+                merged[row["metric"]] = row
+    for row in rows:
+        merged[row["metric"]] = row
+    with open(path, "w") as fh:
+        json.dump(list(merged.values()), fh, indent=1)
+
+
+if __name__ == "__main__":
+    main()
