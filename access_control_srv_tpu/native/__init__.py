@@ -1,0 +1,255 @@
+"""Native host runtime: C++ wire-batch encoder with ctypes bindings.
+
+``NativeBatchEncoder`` parses serialized ``acstpu.Request`` wire bytes
+(protobuf + JSON context payloads) in C++ and fills the kernel row arrays
+directly — the serving-path replacement for the per-request Python encode
+(ops/encode.py), bit-identical by construction and enforced by
+tests/test_native_encoder.py.
+
+The shared library is built on demand with g++ (cached next to the
+source); environments without a toolchain fall back to the Python encoder
+(``available()`` returns False).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..ops import encode as _pyenc
+from ..ops.compile import CompiledPolicies
+from ..ops.encode import RequestBatch
+from ..ops.interner import ABSENT
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "host_encoder.cpp")
+_LIB = os.path.join(_DIR, "libacs_host.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+
+# ptrs order for acs_enc_batch -- must match OutArrays in host_encoder.cpp
+_ARRAY_ORDER = [
+    "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
+    "r_ent_vals", "r_ent_e", "r_ent_valid",
+    "r_inst_run", "r_inst_valid", "r_inst_present", "r_inst_has_owners",
+    "r_inst_owner_ent", "r_inst_owner_inst",
+    "r_prop_vals", "r_prop_sfx", "r_prop_run", "r_prop_tail",
+    "r_op_vals", "r_op_present", "r_op_has_owners",
+    "r_op_owner_ent", "r_op_owner_inst",
+    "r_ra3", "r_ra2", "r_n_ra", "r_hr",
+    "r_ctx_present", "r_n_entity_attrs", "r_has_props", "r_has_target",
+    "r_has_idop", "r_action_crud",
+]
+
+_URN_ORDER = [
+    "entity", "property", "operation", "resourceID", "role",
+    "roleScopingEntity", "roleScopingInstance", "ownerEntity",
+    "ownerInstance", "actionID", "create", "read", "modify", "delete",
+]
+
+
+def _build_lib() -> Optional[str]:
+    """Compile the shared library if missing/stale; returns an error
+    message or None."""
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return None
+    tmp = f"{_LIB}.{os.getpid()}.tmp"  # per-process: concurrent builds race
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as err:
+        return str(err)
+    if proc.returncode != 0:
+        return proc.stderr[-2000:]
+    os.replace(tmp, _LIB)  # atomic: a concurrent loader sees old or new
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build_lib()
+        if err is not None:
+            _build_error = err
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as exc:
+            _build_error = str(exc)
+            return None
+        lib.acs_enc_create.restype = ctypes.c_void_p
+        lib.acs_enc_create.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.acs_enc_destroy.argtypes = [ctypes.c_void_p]
+        lib.acs_enc_n_strings.restype = ctypes.c_int32
+        lib.acs_enc_n_strings.argtypes = [ctypes.c_void_p]
+        lib.acs_enc_string.restype = ctypes.c_int32
+        lib.acs_enc_string.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.acs_enc_batch.restype = ctypes.c_int32
+        lib.acs_enc_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeBatchEncoder:
+    """Wire-bytes -> RequestBatch using the C++ core.
+
+    Constraints (callers fall back to the Python encoder otherwise):
+    - the compiled tree must carry no host-assisted conditions (condition
+      predicates are evaluated in the Python sandbox against rich request
+      objects);
+    - inputs are serialized ``acstpu.Request`` messages (or a
+      ``BatchRequest`` split by the caller).
+    """
+
+    def __init__(self, compiled: CompiledPolicies):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native encoder unavailable: {_build_error}")
+        if compiled.conditions:
+            raise RuntimeError(
+                "native encoder does not cover host-assisted conditions"
+            )
+        self.lib = lib
+        self.compiled = compiled
+
+        interner = compiled.interner
+        urns = compiled.urns
+        # intern URNs/vocab FIRST: these may append to the interner, and the
+        # preload snapshot below must contain every referenced id
+        urn_ids = np.array(
+            [interner.intern(urns.get(name)) for name in _URN_ORDER], np.int32
+        )
+        from ..core.hierarchical_scope import split_entity_urn
+
+        # vocab tails are the entity NAMES (split_entity_urn()[1], the
+        # last-dot segment), matching the Python encoder's relevance check
+        tails = [split_entity_urn(v)[1] for v in compiled.entity_vocab]
+        vocab_tails = np.array(
+            [interner.intern(t) for t in tails], np.int32
+        )
+        tails_ambiguous = len(set(tails)) != len(tails)
+        strings = list(interner._strings)
+        encoded = [s.encode() for s in strings]
+        blob = b"".join(encoded)
+        offs = np.zeros(len(strings) + 1, np.int64)
+        np.cumsum([len(e) for e in encoded], out=offs[1:])
+
+        self._handle = lib.acs_enc_create(
+            blob,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(strings),
+            urn_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            1 if tails_ambiguous else 0,
+            vocab_tails.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(compiled.entity_vocab),
+        )
+        if not self._handle:
+            raise RuntimeError("native interner preload mismatch")
+        self._rgx = _pyenc._RegexCache(compiled.entity_vocab)
+        # the C++ encoder mutates shared state (interner, caches) and
+        # ctypes releases the GIL -- one batch at a time per encoder
+        self._call_lock = threading.Lock()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "lib", None) is not None:
+            self.lib.acs_enc_destroy(handle)
+
+    def _string(self, idx: int) -> str:
+        n = self.lib.acs_enc_string(self._handle, idx, None, 0)
+        buf = ctypes.create_string_buffer(n)
+        self.lib.acs_enc_string(self._handle, idx, buf, n)
+        return buf.raw[:n].decode()
+
+    def encode_wire(self, messages: list[bytes]) -> RequestBatch:
+        """Encode serialized acstpu.Request messages."""
+        B = len(messages)
+        blob = b"".join(messages)
+        offs = np.zeros(B + 1, np.int64)
+        np.cumsum([len(m) for m in messages], out=offs[1:])
+
+        a = _pyenc.alloc_row_arrays(B)
+        eligible = np.ones((B,), np.uint8)
+        batch_entities = np.zeros((max(B, 1) * _pyenc.NR,), np.int32)
+
+        ptrs = (ctypes.c_void_p * (len(_ARRAY_ORDER) + 2))()
+        for i, name in enumerate(_ARRAY_ORDER):
+            ptrs[i] = a[name].ctypes.data
+        ptrs[len(_ARRAY_ORDER)] = eligible.ctypes.data
+        ptrs[len(_ARRAY_ORDER) + 1] = batch_entities.ctypes.data
+
+        with self._call_lock:
+            n_entities = self.lib.acs_enc_batch(
+                self._handle,
+                blob,
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                B,
+                ptrs,
+            )
+            if n_entities < 0:
+                raise ValueError("malformed wire batch")
+
+            # regex matrices over distinct batch entities (host regex work
+            # is per distinct entity value, same as the Python encoder);
+            # the _string readbacks stay under the lock -- they touch the
+            # same C++ interner a concurrent batch would be mutating
+            W = max(len(self.compiled.entity_vocab), 1)
+            E = max(int(n_entities), 1)
+            rgx_set = np.zeros((W, E), bool)
+            pfx_neq = np.zeros((W, E), bool)
+            for e in range(int(n_entities)):
+                value = self._string(int(batch_entities[e]))
+                set_col, neq_col = self._rgx.lookup(value)
+                if set_col:
+                    rgx_set[:, e] = set_col
+                    pfx_neq[:, e] = neq_col
+
+        C = len(self.compiled.conditions)  # always 0 (ctor guard)
+        return RequestBatch(
+            B=B,
+            arrays=a,
+            rgx_set=rgx_set,
+            pfx_neq=pfx_neq,
+            cond_true=np.zeros((C, B), bool),
+            cond_abort=np.zeros((C, B), bool),
+            cond_code=np.full((C, B), 200, np.int32),
+            eligible=eligible.astype(bool),
+            requests=[],
+        )

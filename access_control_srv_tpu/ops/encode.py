@@ -101,6 +101,51 @@ def _flatten_hr(scopes, out: list[tuple[Optional[str], str]]):
             stack.extend(get_field(node, "children") or [])
 
 
+def alloc_row_arrays(B: int) -> dict[str, np.ndarray]:
+    """The per-request kernel row arrays; shared by the Python encoder and
+    the native (C++) wire encoder, which fills the same buffers in place
+    (the ctypes pointer order lives in native/__init__._ARRAY_ORDER)."""
+    return {
+        "r_sub_ids": np.full((B, NSUB), ABSENT, np.int32),
+        "r_sub_vals": np.full((B, NSUB), ABSENT, np.int32),
+        "r_roles": np.full((B, NROLE), ABSENT, np.int32),
+        "r_act_ids": np.full((B, NACT), ABSENT, np.int32),
+        "r_act_vals": np.full((B, NACT), ABSENT, np.int32),
+        "r_ent_vals": np.full((B, NR), ABSENT, np.int32),
+        "r_ent_e": np.zeros((B, NR), np.int32),
+        "r_ent_valid": np.zeros((B, NR), bool),
+        "r_inst_run": np.full((B, NI), ABSENT, np.int32),
+        "r_inst_valid": np.zeros((B, NI), bool),
+        "r_inst_present": np.zeros((B, NI), bool),
+        "r_inst_has_owners": np.zeros((B, NI), bool),
+        "r_inst_owner_ent": np.full((B, NI, NOWN), ABSENT, np.int32),
+        "r_inst_owner_inst": np.full((B, NI, NOWN), ABSENT, np.int32),
+        "r_prop_vals": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_sfx": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_run": np.full((B, NP), ABSENT, np.int32),
+        "r_prop_tail": np.full((B, NP), ABSENT, np.int32),
+        "r_op_vals": np.full((B, NOP), ABSENT, np.int32),
+        "r_op_present": np.zeros((B, NOP), bool),
+        "r_op_has_owners": np.zeros((B, NOP), bool),
+        "r_op_owner_ent": np.full((B, NOP, NOWN), ABSENT, np.int32),
+        "r_op_owner_inst": np.full((B, NOP, NOWN), ABSENT, np.int32),
+        "r_ra3": np.full((B, NRA, 3), ABSENT, np.int32),
+        "r_ra2": np.full((B, NRA, 2), ABSENT, np.int32),
+        "r_n_ra": np.zeros((B,), np.int32),
+        "r_hr": np.full((B, NHR, 2), ABSENT, np.int32),
+        "r_ctx_present": np.zeros((B,), bool),
+        "r_n_entity_attrs": np.zeros((B,), np.int32),
+        "r_has_props": np.zeros((B,), bool),
+        "r_has_target": np.zeros((B,), bool),
+        # verify_acl no-ACL failure-path inputs (reference: verifyACL.ts):
+        # any resourceID/operation attribute triggers the early all-clear
+        # when ACL metadata is absent (:56-59); otherwise empty role
+        # associations fail (:96-100) and only CRUD actions pass (:148-248)
+        "r_has_idop": np.zeros((B,), bool),
+        "r_action_crud": np.zeros((B,), bool),
+    }
+
+
 def encode_requests(
     requests: list[Request],
     compiled: CompiledPolicies,
@@ -144,45 +189,7 @@ def encode_requests(
             batch_entity_values.append(value)
         return idx
 
-    a = {
-        "r_sub_ids": np.full((B, NSUB), ABSENT, np.int32),
-        "r_sub_vals": np.full((B, NSUB), ABSENT, np.int32),
-        "r_roles": np.full((B, NROLE), ABSENT, np.int32),
-        "r_act_ids": np.full((B, NACT), ABSENT, np.int32),
-        "r_act_vals": np.full((B, NACT), ABSENT, np.int32),
-        "r_ent_vals": np.full((B, NR), ABSENT, np.int32),
-        "r_ent_e": np.zeros((B, NR), np.int32),
-        "r_ent_valid": np.zeros((B, NR), bool),
-        "r_inst_run": np.full((B, NI), ABSENT, np.int32),
-        "r_inst_valid": np.zeros((B, NI), bool),
-        "r_inst_present": np.zeros((B, NI), bool),
-        "r_inst_has_owners": np.zeros((B, NI), bool),
-        "r_inst_owner_ent": np.full((B, NI, NOWN), ABSENT, np.int32),
-        "r_inst_owner_inst": np.full((B, NI, NOWN), ABSENT, np.int32),
-        "r_prop_vals": np.full((B, NP), ABSENT, np.int32),
-        "r_prop_sfx": np.full((B, NP), ABSENT, np.int32),
-        "r_prop_run": np.full((B, NP), ABSENT, np.int32),
-        "r_prop_tail": np.full((B, NP), ABSENT, np.int32),
-        "r_op_vals": np.full((B, NOP), ABSENT, np.int32),
-        "r_op_present": np.zeros((B, NOP), bool),
-        "r_op_has_owners": np.zeros((B, NOP), bool),
-        "r_op_owner_ent": np.full((B, NOP, NOWN), ABSENT, np.int32),
-        "r_op_owner_inst": np.full((B, NOP, NOWN), ABSENT, np.int32),
-        "r_ra3": np.full((B, NRA, 3), ABSENT, np.int32),
-        "r_ra2": np.full((B, NRA, 2), ABSENT, np.int32),
-        "r_n_ra": np.zeros((B,), np.int32),
-        "r_hr": np.full((B, NHR, 2), ABSENT, np.int32),
-        "r_ctx_present": np.zeros((B,), bool),
-        "r_n_entity_attrs": np.zeros((B,), np.int32),
-        "r_has_props": np.zeros((B,), bool),
-        "r_has_target": np.zeros((B,), bool),
-        # verify_acl no-ACL failure-path inputs (reference: verifyACL.ts):
-        # any resourceID/operation attribute triggers the early all-clear
-        # when ACL metadata is absent (:56-59); otherwise empty role
-        # associations fail (:96-100) and only CRUD actions pass (:148-248)
-        "r_has_idop": np.zeros((B,), bool),
-        "r_action_crud": np.zeros((B,), bool),
-    }
+    a = alloc_row_arrays(B)
     eligible = np.ones((B,), bool)
 
     def mark(b, reason=None):

@@ -41,6 +41,7 @@ class HybridEvaluator:
         self._version = 0
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
+        self._native_encoder = None
         self._lock = threading.Lock()
         self._compile_thread: Optional[threading.Thread] = None
         if backend != "oracle":
@@ -64,10 +65,12 @@ class HybridEvaluator:
             kernel = None
             if compiled.supported and compiled.n_rules > 0:
                 kernel = DecisionKernel(compiled)
+            native_encoder = self._make_native_encoder(compiled, kernel)
             with self._lock:
                 if version >= self._version:  # drop stale compiles
                     self._compiled = compiled
                     self._kernel = kernel
+                    self._native_encoder = native_encoder
             if self.logger and not compiled.supported:
                 self.logger.warning(
                     "policy tree not kernel-supported; serving from oracle",
@@ -81,9 +84,42 @@ class HybridEvaluator:
         else:
             compile_and_swap()
 
+    def _make_native_encoder(self, compiled, kernel):
+        """C++ wire-batch encoder for the gRPC fast path; None when the
+        native library or the tree shape does not support it."""
+        if kernel is None or compiled.conditions:
+            return None
+        try:
+            from .. import native
+
+            if not native.available():
+                return None
+            return native.NativeBatchEncoder(compiled)
+        except Exception as err:  # toolchain-less environments
+            if self.logger:
+                self.logger.info("native encoder disabled: %s", err)
+            return None
+
     @property
     def kernel_active(self) -> bool:
         return self._kernel is not None
+
+    @property
+    def native_active(self) -> bool:
+        return self._native_encoder is not None
+
+    def is_allowed_batch_wire(self, messages: list[bytes]):
+        """Native fast path: serialized acstpu.Request messages -> per-row
+        (decision, cacheable, status, eligible).  Returns None when the
+        native encoder is unavailable (caller falls back to the pb path)."""
+        with self._lock:
+            kernel = self._kernel
+            encoder = self._native_encoder
+        if kernel is None or encoder is None or self.backend == "oracle":
+            return None
+        batch = encoder.encode_wire(messages)
+        decision, cacheable, status = kernel.evaluate(batch)
+        return batch, decision, cacheable, status
 
     # ------------------------------------------------------------ evaluation
 

@@ -26,7 +26,47 @@ from ..models.model import (
     ReverseQuery,
     Target,
 )
+from ..ops.compile import DECISION_NAMES
 from .gen import access_control_pb2 as pb
+
+
+def split_batch_request(data: bytes) -> Optional[list[bytes]]:
+    """Split a serialized BatchRequest envelope (field 1: repeated Request)
+    into per-request message bytes without protobuf deserialization.
+    Returns None on any unexpected field (caller falls back to pb)."""
+    messages: list[bytes] = []
+    i, n = 0, len(data)
+    while i < n:
+        key = 0
+        shift = 0
+        while True:
+            if i >= n:
+                return None
+            byte = data[i]
+            i += 1
+            key |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                break
+        if key >> 3 != 1 or key & 7 != 2:
+            return None
+        length = 0
+        shift = 0
+        while True:
+            if i >= n:
+                return None
+            byte = data[i]
+            i += 1
+            length |= (byte & 0x7F) << shift
+            shift += 7
+            if not byte & 0x80:
+                break
+        if i + length > n:
+            return None
+        messages.append(data[i:i + length])
+        i += length
+    return messages
+
 
 DECISION_TO_PB = {
     Decision.PERMIT: pb.PERMIT,
@@ -297,7 +337,63 @@ class GrpcServer:
             response = worker.service.is_allowed(request_from_pb(request))
             return response_to_pb(response)
 
-        def is_allowed_batch(request, context):
+        def is_allowed_batch(raw, context):
+            # raw BatchRequest bytes: try the native wire fast path (C++
+            # encoder + kernel, no python deserialization for eligible
+            # rows); fall back to full pb parse + service path
+            messages = split_batch_request(raw)
+            evaluator = worker.service.evaluator
+            if messages is not None and evaluator is not None:
+                out = None
+                try:
+                    out = evaluator.is_allowed_batch_wire(messages)
+                except Exception:
+                    out = None
+                if out is not None:
+                    batch, decision, cacheable, status = out
+                    responses: list = [None] * len(messages)
+                    fallback_rows: list[int] = []
+                    fallback_reqs: list = []
+                    for b, message in enumerate(messages):
+                        if not batch.eligible[b] or status[b] != 200:
+                            # collect fallback rows for ONE batched oracle
+                            # call (per-row service.is_allowed would wait
+                            # out a micro-batch window each)
+                            try:
+                                req = request_from_pb(
+                                    pb.Request.FromString(message)
+                                )
+                            except Exception as err:
+                                responses[b] = pb.Response(
+                                    decision=pb.DENY,
+                                    operation_status=pb.OperationStatus(
+                                        code=500, message=str(err)
+                                    ),
+                                )
+                                continue
+                            fallback_rows.append(b)
+                            fallback_reqs.append(req)
+                            continue
+                        cach = (
+                            False if cacheable[b] < 0 else bool(cacheable[b])
+                        )
+                        responses[b] = pb.Response(
+                            decision=DECISION_TO_PB[
+                                DECISION_NAMES[int(decision[b])]
+                            ],
+                            evaluation_cacheable=cach,
+                            operation_status=pb.OperationStatus(
+                                code=200, message="success"
+                            ),
+                        )
+                    if fallback_reqs:
+                        for b, resp in zip(
+                            fallback_rows,
+                            worker.service.is_allowed_batch(fallback_reqs),
+                        ):
+                            responses[b] = response_to_pb(resp)
+                    return pb.BatchResponse(responses=responses)
+            request = pb.BatchRequest.FromString(raw)
             responses = worker.service.is_allowed_batch(
                 [request_from_pb(r) for r in request.requests]
             )
@@ -311,8 +407,12 @@ class GrpcServer:
 
         ac_handlers = {
             "IsAllowed": _unary(is_allowed, pb.Request, pb.Response),
-            "IsAllowedBatch": _unary(
-                is_allowed_batch, pb.BatchRequest, pb.BatchResponse
+            # raw-bytes deserializer: the handler splits the envelope
+            # itself so eligible rows never touch python protobuf
+            "IsAllowedBatch": grpc.unary_unary_rpc_method_handler(
+                is_allowed_batch,
+                request_deserializer=lambda raw: raw,
+                response_serializer=pb.BatchResponse.SerializeToString,
             ),
             "WhatIsAllowed": _unary(what_is_allowed, pb.Request, pb.ReverseQuery),
         }

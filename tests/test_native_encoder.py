@@ -1,0 +1,251 @@
+"""Native C++ wire encoder vs Python encoder: array-level differential.
+
+The native encoder (access_control_srv_tpu/native) parses serialized
+``acstpu.Request`` wire bytes directly; it must produce exactly the same
+row arrays, eligibility mask, regex matrices and (through the kernel) the
+same decisions as the Python encoder run on the deserialized requests.
+"""
+
+import numpy as np
+import pytest
+
+from access_control_srv_tpu import native
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    compile_policies,
+    encode_requests,
+)
+from access_control_srv_tpu.srv.transport_grpc import request_from_pb, request_to_pb
+
+from .test_kernel_differential import DEC_CODE, grid_requests
+from .utils import make_engine
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native encoder unavailable: {native.build_error()}",
+)
+
+
+def wire_roundtrip(requests):
+    """Serialize to wire bytes + the deserialized twins the Python encoder
+    sees (the honest comparison: both sides read the same wire)."""
+    messages = [request_to_pb(r).SerializeToString() for r in requests]
+    twins = []
+    for m in messages:
+        from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+        from access_control_srv_tpu.srv.service import unmarshall_context
+
+        msg = pb.Request.FromString(m)
+        req = request_from_pb(msg)
+        if isinstance(req.context, dict):
+            req.context = unmarshall_context(req.context)
+        twins.append(req)
+    return messages, twins
+
+
+@pytest.mark.parametrize(
+    "fixture_name",
+    [
+        "basic_policies.yml",
+        "policy_targets.yml",
+        "policy_set_targets.yml",
+        "role_scopes.yml",
+        "acl_policies.yml",
+        "props_single.yml",
+        "props_multi_rules_entities.yml",
+        "ops_multi.yml",
+    ],
+)
+def test_wire_differential(fixture_name):
+    engine = make_engine(fixture_name)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    if compiled.conditions:
+        pytest.skip("condition fixtures stay on the Python encoder")
+    enc = native.NativeBatchEncoder(compiled)
+
+    requests = grid_requests(n=120, seed=31)
+    messages, twins = wire_roundtrip(requests)
+    nb = enc.encode_wire(messages)
+    pb_batch = encode_requests(twins, compiled)
+
+    assert np.array_equal(nb.eligible, pb_batch.eligible)
+    for name in nb.arrays:
+        assert np.array_equal(nb.arrays[name], pb_batch.arrays[name]), name
+    assert np.array_equal(nb.rgx_set, pb_batch.rgx_set)
+    assert np.array_equal(nb.pfx_neq, pb_batch.pfx_neq)
+    assert nb.eligible.sum() > 60  # the sweep must exercise the kernel path
+
+
+def test_wire_decisions_match_oracle():
+    engine = make_engine("role_scopes.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+    kernel = DecisionKernel(compiled)
+
+    requests = grid_requests(n=100, seed=77)
+    messages, twins = wire_roundtrip(requests)
+    nb = enc.encode_wire(messages)
+    decision, cacheable, status = kernel.evaluate(nb)
+    for b, twin in enumerate(twins):
+        if not nb.eligible[b]:
+            continue
+        expected = engine.is_allowed(twin)
+        assert decision[b] == DEC_CODE[expected.decision], b
+
+
+def test_edge_shapes():
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    cases = [
+        Request(target=None, context=None),  # no target -> ineligible
+        Request(target=Target(subjects=[], resources=[], actions=[]),
+                context=None),
+        Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"], value="member")],
+                resources=[],
+                actions=[],
+            ),
+            # token subject -> host path
+            context={"subject": {"token": "tok"}, "resources": []},
+        ),
+        Request(
+            target=Target(
+                subjects=[],
+                # unknown resource attribute id -> ineligible
+                resources=[Attribute(id="custom:attr", value="v")],
+                actions=[],
+            ),
+            context=None,
+        ),
+    ]
+    messages, twins = wire_roundtrip(cases)
+    nb = enc.encode_wire(messages)
+    pb_batch = encode_requests(twins, compiled)
+    assert np.array_equal(nb.eligible, pb_batch.eligible)
+    for name in nb.arrays:
+        assert np.array_equal(nb.arrays[name], pb_batch.arrays[name]), name
+
+
+def test_conditions_tree_rejected():
+    engine = make_engine("conditions.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    if not compiled.conditions:
+        pytest.skip("fixture has no conditions")
+    with pytest.raises(RuntimeError):
+        native.NativeBatchEncoder(compiled)
+
+
+def test_native_wire_path_end_to_end():
+    """The gRPC batch endpoint must take the native path (not silently
+    fall back) and agree with the oracle."""
+    import os
+
+    from access_control_srv_tpu.srv import Worker
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+    from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+    from .test_grpc_transport import SEED, wire_request
+
+    worker = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+        }
+    )
+    server = GrpcServer(worker, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    try:
+        assert worker.evaluator.native_active, "native encoder should engage"
+        batch = pb.BatchRequest(
+            requests=[
+                wire_request(),
+                wire_request(role="nobody"),
+                wire_request(),
+            ]
+        )
+        out = client.is_allowed_batch(batch)
+        decisions = [r.decision for r in out.responses]
+        assert decisions == [pb.PERMIT, pb.INDETERMINATE, pb.PERMIT]
+        assert all(r.operation_status.code == 200 for r in out.responses)
+    finally:
+        client.close()
+        server.stop()
+        worker.stop()
+
+
+def test_malformed_wire_rows_not_fabricated():
+    """Corrupt protobuf or JSON must never produce a fabricated 200
+    decision from the native path -- such rows go ineligible."""
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+
+    good = wire_roundtrip(grid_requests(n=1, seed=5))[0][0]
+    bad_proto = good + b"\xff\xff\xff"          # trailing garbage field
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    msg = pb.Request.FromString(good)
+    msg.context.subject.value = b'{"id": "u", "role_assoc'  # truncated JSON
+    bad_json = msg.SerializeToString()
+
+    nb = enc.encode_wire([good, bad_proto, bad_json])
+    assert nb.eligible[0]
+    assert not nb.eligible[1]
+    assert not nb.eligible[2]
+
+
+def test_concurrent_encode_wire():
+    """Concurrent batches on one encoder must stay consistent (the
+    interner is shared mutable state guarded by the encoder lock)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+    kernel = DecisionKernel(compiled)
+
+    def job(seed):
+        reqs = grid_requests(n=40, seed=seed)
+        messages, twins = wire_roundtrip(reqs)
+        nb = enc.encode_wire(messages)
+        decision, _, status = kernel.evaluate(nb)
+        out = []
+        for b, twin in enumerate(twins):
+            if nb.eligible[b] and status[b] == 200:
+                out.append((b, int(decision[b]), engine.is_allowed(twin).decision))
+        return out
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        for rows in pool.map(job, range(200, 216)):
+            for b, got, expected in rows:
+                assert got == DEC_CODE[expected], b
+
+
+def test_trailing_garbage_json_rejected():
+    """JSON with trailing garbage or non-RFC numbers must not stay
+    kernel-eligible (json.loads would raise on the pb path)."""
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+
+    good = wire_roundtrip(grid_requests(n=1, seed=5))[0][0]
+    cases = [b'{"id": "u"}garbage', b'{"n": +5}', b'{"n": -}', b'{"n": 5.}']
+    messages = []
+    for payload in cases:
+        msg = pb.Request.FromString(good)
+        msg.context.subject.value = payload
+        messages.append(msg.SerializeToString())
+    nb = enc.encode_wire(messages)
+    assert not nb.eligible.any()
