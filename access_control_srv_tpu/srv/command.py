@@ -55,6 +55,7 @@ class CommandInterface:
             "config_update": self.config_update,
             "flush_cache": self.flush_cache,
             "set_api_key": self.set_api_key,
+            "metrics": self.metrics,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -119,6 +120,14 @@ class CommandInterface:
         if self.cache is not None:
             count = self.cache.evict_prefix(f"cache:{pattern}" if pattern else "")
         return {"status": "flushed", "evicted": count}
+
+    def metrics(self, payload: dict) -> dict:
+        """Latency histograms + decision/path counters (SURVEY.md §5:
+        request-latency histograms at the serving shell)."""
+        telemetry = getattr(self.service, "telemetry", None)
+        if telemetry is None:
+            return {"error": "telemetry not wired"}
+        return telemetry.snapshot()
 
     def set_api_key(self, payload: dict) -> dict:
         self.api_key = (payload or {}).get("authentication", {}).get("apiKey") or (

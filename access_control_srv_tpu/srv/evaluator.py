@@ -33,10 +33,12 @@ class HybridEvaluator:
         backend: str = "hybrid",  # oracle | kernel | hybrid
         logger=None,
         async_compile: bool = False,
+        telemetry=None,
     ):
         self.engine = engine
         self.backend = backend
         self.logger = logger
+        self.telemetry = telemetry
         self.async_compile = async_compile
         self._version = 0
         self._compiled = None
@@ -119,6 +121,11 @@ class HybridEvaluator:
             return None
         batch = encoder.encode_wire(messages)
         decision, cacheable, status = kernel.evaluate(batch)
+        n_served = sum(
+            1 for b in range(len(messages))
+            if batch.eligible[b] and status[b] == 200
+        )
+        self._count_path("native-wire", n_served)
         return batch, decision, cacheable, status
 
     # ------------------------------------------------------------ evaluation
@@ -131,15 +138,26 @@ class HybridEvaluator:
     def what_is_allowed(self, request):
         return self.engine.what_is_allowed(request)
 
+    def _count_path(self, path: str, rows: int) -> None:
+        if self.telemetry is not None and rows:
+            self.telemetry.paths.inc(path, rows)
+
     def is_allowed_batch(self, requests: list) -> list[Response]:
         with self._lock:
             kernel = self._kernel
             compiled = self._compiled
         if self.backend == "oracle" or kernel is None:
+            self._count_path("oracle", len(requests))
             return [self.engine.is_allowed(r) for r in requests]
 
         batch = encode_requests(requests, compiled, self.engine.resource_adapter)
         decision, cacheable, status = kernel.evaluate(batch)
+        n_oracle = sum(
+            1 for b in range(len(requests))
+            if not batch.eligible[b] or status[b] != 200
+        )
+        self._count_path("oracle", n_oracle)
+        self._count_path("kernel", len(requests) - n_oracle)
         responses: list[Response] = []
         for b, request in enumerate(requests):
             if not batch.eligible[b] or status[b] != 200:

@@ -10,6 +10,7 @@ protobuf-Any-style ``{"value": <json bytes>}``), and policy loading in
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Optional
 
 from ..core.engine import AccessController
@@ -64,21 +65,33 @@ def coerce_request(request: Any) -> Request:
 
 class AccessControlService:
     def __init__(self, cfg, engine: AccessController, evaluator=None,
-                 store=None, logger=None):
+                 store=None, logger=None, telemetry=None):
         self.cfg = cfg
         self.engine = engine
         self.evaluator = evaluator
         self.store = store
         self.logger = logger
+        self.telemetry = telemetry
         # when set (Worker wires it), concurrent single isAllowed calls are
         # coalesced into kernel batches instead of hitting the oracle 1-by-1
         self.batcher = None
+
+    def _observe(self, histogram_name, t0, decisions=()):
+        """One helper for success AND deny-on-exception paths so served
+        responses always match the counters."""
+        telemetry = self.telemetry
+        if telemetry is None:
+            return
+        getattr(telemetry, histogram_name).observe(time.perf_counter() - t0)
+        for decision in decisions:
+            telemetry.decisions.inc(decision)
 
     # ------------------------------------------------------------- endpoints
 
     def is_allowed(self, request: Any) -> Response:
         """Deny-by-default on any evaluation exception
         (reference: accessControlService.ts:62-81)."""
+        t0 = time.perf_counter()
         try:
             req = coerce_request(request)
             if self.batcher is not None:
@@ -86,13 +99,17 @@ class AccessControlService:
                 # rendezvous can block for up to hrReqTimeout, which must
                 # never happen on the batcher's collector thread
                 self.engine.prepare_context(req)
-                return self.batcher.is_allowed(req)
-            if self.evaluator is not None:
-                return self.evaluator.is_allowed(req)
-            return self.engine.is_allowed(req)
+                response = self.batcher.is_allowed(req)
+            elif self.evaluator is not None:
+                response = self.evaluator.is_allowed(req)
+            else:
+                response = self.engine.is_allowed(req)
+            self._observe("is_allowed_latency", t0, (response.decision,))
+            return response
         except Exception as err:
             if self.logger:
                 self.logger.exception("isAllowed failed")
+            self._observe("is_allowed_latency", t0, (Decision.DENY,))
             code = getattr(err, "code", 500)
             return Response(
                 decision=Decision.DENY,
@@ -105,9 +122,12 @@ class AccessControlService:
             )
 
     def is_allowed_batch(self, requests: list) -> list[Response]:
+        t0 = time.perf_counter()
         try:
             reqs = [coerce_request(r) for r in requests]
         except Exception as err:
+            self._observe("batch_latency", t0,
+                          [Decision.DENY] * len(requests))
             code = getattr(err, "code", 500)
             status = OperationStatus(
                 code=code if isinstance(code, int) else 500, message=str(err)
@@ -118,12 +138,17 @@ class AccessControlService:
             ]
         try:
             if self.evaluator is not None:
-                return self.evaluator.is_allowed_batch(reqs)
-            return [self.engine.is_allowed(r) for r in reqs]
+                responses = self.evaluator.is_allowed_batch(reqs)
+            else:
+                responses = [self.engine.is_allowed(r) for r in reqs]
+            self._observe("batch_latency", t0,
+                          [r.decision for r in responses])
+            return responses
         except Exception as err:
             # same deny-on-exception contract as the single-request path
             if self.logger:
                 self.logger.exception("isAllowedBatch failed")
+            self._observe("batch_latency", t0, [Decision.DENY] * len(reqs))
             code = getattr(err, "code", 500)
             status = OperationStatus(
                 code=code if isinstance(code, int) else 500,
@@ -136,12 +161,16 @@ class AccessControlService:
 
     def what_is_allowed(self, request: Any) -> ReverseQuery:
         """(reference: accessControlService.ts:83-101)"""
+        t0 = time.perf_counter()
         try:
             req = coerce_request(request)
-            return self.engine.what_is_allowed(req)
+            rq = self.engine.what_is_allowed(req)
+            self._observe("what_is_allowed_latency", t0)
+            return rq
         except Exception as err:
             if self.logger:
                 self.logger.exception("whatIsAllowed failed")
+            self._observe("what_is_allowed_latency", t0)
             code = getattr(err, "code", 500)
             return ReverseQuery(
                 policy_sets=[],

@@ -1,0 +1,194 @@
+"""Observability: latency histograms, decision counters, secret-masking
+structured logging, and JAX profiler hooks.
+
+The reference's observability is winston structured logs with field masking
+of secrets (``maskFields``: password/token, reference: cfg/config.json:10-46)
+and no metrics endpoint; SURVEY.md §5 specifies the new framework adds a
+JAX profiler + XLA dump hook on the evaluator and request-latency
+histograms at the serving shell.  All collection here is lock-guarded,
+allocation-free on the hot path (fixed log2 buckets), and exposed as a
+plain dict snapshot (`Telemetry.snapshot`) that the command interface
+serves from ``health_check``/``metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+# histogram buckets: upper bounds in seconds (log-spaced ~x4 from 50us to 50s)
+_BUCKETS = [
+    50e-6, 200e-6, 800e-6, 3.2e-3, 12.8e-3, 51.2e-3, 0.205, 0.82, 3.3, 13.1,
+    52.4, float("inf"),
+]
+
+MASK_FIELDS = ("password", "token", "apiKey", "api_key", "authorization")
+_MASK = "***"
+
+
+def mask_secrets(obj: Any, fields: tuple = MASK_FIELDS) -> Any:
+    """Deep-copy ``obj`` with secret-named fields replaced (the winston
+    maskFields analog, reference: cfg/config.json:16-24).  Key matching is
+    case-insensitive substring on the configured names."""
+    lowered = tuple(f.lower() for f in fields)
+    if isinstance(obj, dict):
+        out = {}
+        for key, value in obj.items():
+            if isinstance(key, str) and any(f in key.lower() for f in lowered):
+                out[key] = _MASK
+            else:
+                out[key] = mask_secrets(value, fields)
+        return out
+    if isinstance(obj, tuple):
+        items = [mask_secrets(v, fields) for v in obj]
+        if hasattr(obj, "_fields"):  # namedtuple: positional ctor
+            return type(obj)(*items)
+        return tuple(items)
+    if isinstance(obj, list):
+        return [mask_secrets(v, fields) for v in obj]
+    return obj
+
+
+_STANDARD_RECORD_FIELDS = frozenset(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime", "taskName"}
+
+
+class MaskingFilter(logging.Filter):
+    """Masks secret fields inside dict/list log arguments and inside
+    ``extra`` payloads (which land as non-standard LogRecord attributes)
+    before they are formatted."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if isinstance(record.args, dict):
+            record.args = mask_secrets(record.args)
+        elif isinstance(record.args, tuple):
+            record.args = tuple(
+                mask_secrets(a) if isinstance(a, (dict, list)) else a
+                for a in record.args
+            )
+        for key, value in list(record.__dict__.items()):
+            if key in _STANDARD_RECORD_FIELDS:
+                continue
+            if isinstance(value, (dict, list)):
+                setattr(record, key, mask_secrets(value))
+        return True
+
+
+def make_logger(name: str = "access-control-srv-tpu",
+                level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not any(isinstance(f, MaskingFilter) for f in logger.filters):
+        logger.addFilter(MaskingFilter())
+    return logger
+
+
+class Histogram:
+    """Fixed-bucket latency histogram; thread-safe, O(1) observe."""
+
+    def __init__(self):
+        self._counts = [0] * len(_BUCKETS)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        idx = 0
+        for idx, bound in enumerate(_BUCKETS):  # 12 buckets: linear scan ok
+            if seconds <= bound:
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += seconds
+            self._n += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
+        out = {
+            "count": n,
+            "sum_s": round(total, 6),
+            "mean_s": round(total / n, 6) if n else None,
+            "buckets": {},
+        }
+        cumulative = 0
+        for bound, count in zip(_BUCKETS, counts):
+            cumulative += count
+            label = "inf" if math.isinf(bound) else f"{bound:g}"
+            out["buckets"][label] = cumulative
+        return out
+
+
+class Counter:
+    def __init__(self):
+        self._values: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + by
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._values)
+
+
+class Telemetry:
+    """Per-worker metrics registry wired into the service facade."""
+
+    def __init__(self):
+        self.is_allowed_latency = Histogram()
+        self.what_is_allowed_latency = Histogram()
+        self.batch_latency = Histogram()
+        self.decisions = Counter()
+        self.paths = Counter()  # kernel / oracle / native-wire rows
+        self.start_time = time.time()
+
+    @contextmanager
+    def timed(self, histogram: Histogram):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            histogram.observe(time.perf_counter() - t0)
+
+    def record_decision(self, decision: str) -> None:
+        self.decisions.inc(decision)
+
+    def record_path(self, path: str, rows: int = 1) -> None:
+        self.paths.inc(path, rows)
+
+    def snapshot(self) -> dict:
+        return {
+            "uptime_s": round(time.time() - self.start_time, 3),
+            "is_allowed_latency": self.is_allowed_latency.snapshot(),
+            "what_is_allowed_latency": self.what_is_allowed_latency.snapshot(),
+            "batch_latency": self.batch_latency.snapshot(),
+            "decisions": self.decisions.snapshot(),
+            "paths": self.paths.snapshot(),
+        }
+
+
+@contextmanager
+def profile_evaluator(out_dir: str, host_tracer_level: int = 2):
+    """JAX profiler capture around an evaluation region; the trace lands in
+    ``out_dir`` for xprof/tensorboard (SURVEY.md §5 tracing hook)."""
+    import jax
+
+    jax.profiler.start_trace(out_dir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def xla_dump_flags(out_dir: str) -> str:
+    """The XLA_FLAGS value that dumps HLO for the compiled kernels; set
+    before the first jit for compiler-level inspection."""
+    return f"--xla_dump_to={out_dir}"

@@ -341,6 +341,9 @@ class GrpcServer:
             # raw BatchRequest bytes: try the native wire fast path (C++
             # encoder + kernel, no python deserialization for eligible
             # rows); fall back to full pb parse + service path
+            import time as _time
+
+            t0 = _time.perf_counter()
             messages = split_batch_request(raw)
             evaluator = worker.service.evaluator
             if messages is not None and evaluator is not None:
@@ -392,6 +395,15 @@ class GrpcServer:
                             worker.service.is_allowed_batch(fallback_reqs),
                         ):
                             responses[b] = response_to_pb(resp)
+                    telemetry = getattr(worker, "telemetry", None)
+                    if telemetry is not None:
+                        telemetry.batch_latency.observe(
+                            _time.perf_counter() - t0
+                        )
+                        for resp in responses:
+                            telemetry.decisions.inc(
+                                PB_TO_DECISION.get(resp.decision, "DENY")
+                            )
                     return pb.BatchResponse(responses=responses)
             request = pb.BatchRequest.FromString(raw)
             responses = worker.service.is_allowed_batch(

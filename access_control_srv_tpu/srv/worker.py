@@ -5,7 +5,6 @@ interface and event listeners into a running service
 
 from __future__ import annotations
 
-import logging
 import os
 from typing import Optional
 
@@ -22,6 +21,7 @@ from .events import EventBus, OffsetStore
 from .identity import StaticIdentityClient
 from .service import AccessControlService
 from .store import PolicyStore
+from .telemetry import Telemetry, make_logger
 
 
 def _yaml_list(path: str) -> list[dict]:
@@ -41,6 +41,7 @@ def _yaml_list(path: str) -> list[dict]:
 class Worker:
     def __init__(self):
         self.cfg: Optional[Config] = None
+        self.telemetry: Optional[Telemetry] = None
         self.engine: Optional[AccessController] = None
         self.evaluator: Optional[HybridEvaluator] = None
         self.store: Optional[PolicyStore] = None
@@ -62,7 +63,8 @@ class Worker:
     ) -> "Worker":
         self.cfg = cfg if isinstance(cfg, Config) else Config(cfg or {})
         cfg = self.cfg
-        self.logger = logger or logging.getLogger("access-control-srv-tpu")
+        self.logger = logger or make_logger()
+        self.telemetry = Telemetry()
 
         # event bus + offsets (Kafka + OffsetStore analog)
         self.bus = EventBus()
@@ -99,6 +101,7 @@ class Worker:
             backend=cfg.get("evaluator:backend", "hybrid"),
             logger=self.logger,
             async_compile=bool(cfg.get("evaluator:async_compile", False)),
+            telemetry=self.telemetry,
         )
 
         # policy store with self-authorization hook; the hook consults the
@@ -116,7 +119,8 @@ class Worker:
 
         # service facade + command interface + micro-batcher
         self.service = AccessControlService(
-            cfg, self.engine, self.evaluator, self.store, self.logger
+            cfg, self.engine, self.evaluator, self.store, self.logger,
+            telemetry=self.telemetry,
         )
         self.command_interface = CommandInterface(
             cfg,

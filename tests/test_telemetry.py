@@ -1,0 +1,163 @@
+"""Observability: latency histograms, decision counters, secret-masking
+logging, and the metrics command (SURVEY.md §5 aux subsystems)."""
+
+import logging
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.telemetry import (
+    Histogram,
+    MaskingFilter,
+    Telemetry,
+    mask_secrets,
+)
+
+from .test_srv import admin_request, seed_cfg
+
+
+def test_mask_secrets_deep():
+    payload = {
+        "subject": {"id": "u", "token": "s3cret", "password": "pw"},
+        "items": [{"apiKey": "k", "name": "ok"}],
+        "authorization": "Bearer xyz",
+        "note": "keep",
+    }
+    masked = mask_secrets(payload)
+    assert masked["subject"]["token"] == "***"
+    assert masked["subject"]["password"] == "***"
+    assert masked["items"][0]["apiKey"] == "***"
+    assert masked["authorization"] == "***"
+    assert masked["note"] == "keep"
+    assert masked["subject"]["id"] == "u"
+    # original untouched
+    assert payload["subject"]["token"] == "s3cret"
+
+
+def test_masking_filter_on_log_args():
+    # a single-dict args tuple is unpacked to the dict by LogRecord itself
+    record = logging.LogRecord(
+        "t", logging.INFO, __file__, 1, "ctx %s", ({"token": "abc"},), None
+    )
+    assert MaskingFilter().filter(record)
+    assert record.args["token"] == "***"
+
+    record = logging.LogRecord(
+        "t", logging.INFO, __file__, 1, "a=%s b=%s",
+        ({"password": "x"}, "plain"), None
+    )
+    assert MaskingFilter().filter(record)
+    assert record.args[0]["password"] == "***"
+    assert record.args[1] == "plain"
+
+
+def test_histogram_buckets_and_mean():
+    h = Histogram()
+    for v in (1e-5, 1e-3, 0.1, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["buckets"]["inf"] == 4
+    assert snap["buckets"]["5e-05"] == 1
+    assert abs(snap["mean_s"] - (1e-5 + 1e-3 + 0.1 + 5.0) / 4) < 1e-6
+
+
+def test_service_records_latency_and_decisions():
+    w = Worker().start(seed_cfg())
+    try:
+        for _ in range(3):
+            w.service.is_allowed(admin_request())
+        w.service.is_allowed_batch([admin_request(), admin_request()])
+        w.service.what_is_allowed(admin_request())
+        snap = w.telemetry.snapshot()
+        assert snap["is_allowed_latency"]["count"] == 3
+        assert snap["batch_latency"]["count"] == 1
+        assert snap["what_is_allowed_latency"]["count"] == 1
+        assert snap["decisions"].get("PERMIT", 0) >= 5
+        # the metrics command serves the same snapshot
+        via_cmd = w.command_interface.command("metrics", {})
+        assert via_cmd["decisions"] == snap["decisions"]
+    finally:
+        w.stop()
+
+
+def test_telemetry_paths_counter():
+    t = Telemetry()
+    t.record_path("kernel", 10)
+    t.record_path("oracle", 2)
+    t.record_path("kernel", 5)
+    assert t.paths.snapshot() == {"kernel": 15, "oracle": 2}
+
+
+def test_error_paths_still_counted():
+    w = Worker().start(seed_cfg())
+    try:
+        # a request shape that blows up in coercion -> deny-on-exception
+        w.service.is_allowed({"target": object()})
+        snap = w.telemetry.snapshot()
+        assert snap["is_allowed_latency"]["count"] == 1
+        assert snap["decisions"].get("DENY", 0) == 1
+    finally:
+        w.stop()
+
+
+def test_paths_counter_instrumented():
+    w = Worker().start(seed_cfg())
+    try:
+        w.service.is_allowed_batch([admin_request(), admin_request()])
+        paths = w.telemetry.paths.snapshot()
+        assert paths.get("kernel", 0) == 2, paths
+    finally:
+        w.stop()
+
+
+def test_mask_namedtuple_survives():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", "x y")
+    masked = mask_secrets({"p": Point(1, 2), "token": "x"})
+    assert masked["p"] == Point(1, 2)
+    assert masked["token"] == "***"
+
+
+def test_masking_filter_extra_payload():
+    record = logging.LogRecord("t", logging.INFO, __file__, 1, "msg", (), None)
+    record.ctx = {"token": "leak"}
+    assert MaskingFilter().filter(record)
+    assert record.ctx["token"] == "***"
+
+
+def test_native_wire_path_records_metrics():
+    import os
+
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+    from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+    from .test_grpc_transport import SEED, wire_request
+
+    w = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+        }
+    )
+    server = GrpcServer(w, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    try:
+        if not w.evaluator.native_active:
+            import pytest
+
+            pytest.skip("native encoder unavailable")
+        client.is_allowed_batch(
+            pb.BatchRequest(requests=[wire_request(), wire_request()])
+        )
+        snap = w.telemetry.snapshot()
+        assert snap["batch_latency"]["count"] == 1
+        assert snap["decisions"].get("PERMIT", 0) == 2
+        assert snap["paths"].get("native-wire", 0) == 2
+    finally:
+        client.close()
+        server.stop()
+        w.stop()
