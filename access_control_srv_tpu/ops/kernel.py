@@ -64,14 +64,12 @@ def _member(needle, haystack):
     return jnp.any((haystack == needle) & (haystack >= 0))
 
 
-def _evaluate_one(c: dict, r: dict):
-    """Decision for a single encoded request; vmapped over the batch.
+def _match_targets(c: dict, r: dict):
+    """Stages A (target matching) + B (HR scopes) for one request: returns
+    per-target-row match vectors the rule/policy stages gather from.
 
-    ``c``: compiled policy arrays (closed over, replicated across devices).
-    ``r``: per-request encoded arrays.
-    Returns (decision, cacheable, status_code) int32 scalars where
-    decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
-    """
+    Factored out so the rule-sharded kernel (parallel/rule_shard.py) can run
+    it against a per-device compacted target subtable."""
     T = c["t_role"].shape[0]
 
     # ---------------------------------------------------------------- A: targets
@@ -281,7 +279,23 @@ def _evaluate_one(c: dict, r: dict):
         & ~op_bad.any(axis=1)
     )
 
-    # -------------------------------------------------------------- C: rules
+    return {
+        "tm_ex_p": tm_ex_p,
+        "tm_ex_d": tm_ex_d,
+        "tm_rg_p": tm_rg_p,
+        "tm_rg_d": tm_rg_d,
+        "hr_pass": hr_pass,
+    }
+
+
+def _rule_predicates(c: dict, r: dict, m: dict):
+    """Stage C: per-rule reachability, ACL gate and condition wiring;
+    shared by the single-device and rule-sharded kernels (the latter passes
+    a KR-chunked ``c`` with a compacted target subtable)."""
+    tm_ex_p, tm_ex_d = m["tm_ex_p"], m["tm_ex_d"]
+    tm_rg_p, tm_rg_d = m["tm_rg_p"], m["tm_rg_d"]
+    hr_pass = m["hr_pass"]
+
     def gather_t(table, idx):
         return jnp.take(table, idx, axis=0)
 
@@ -313,8 +327,21 @@ def _evaluate_one(c: dict, r: dict):
         cond_t = jnp.ones_like(cond_idx, dtype=bool)
         cond_a = jnp.zeros_like(cond_idx, dtype=bool)
         cond_c = jnp.full_like(cond_idx, 200)
+    return reached, acl_rule, has_cond, cond_t, cond_a, cond_c
 
-    # --------------------------------------- D: set-level exact match + gates
+
+def _policy_gates(c: dict, r: dict, m: dict):
+    """Stage D: set-level exact match, carried policyEffect, multi-entity
+    recheck and the policy/set gates (reference: accessController.ts
+    :130-195, 429-463); shared by both kernels."""
+    tm_ex_p, tm_ex_d = m["tm_ex_p"], m["tm_ex_d"]
+    tm_rg_p, tm_rg_d = m["tm_rg_p"], m["tm_rg_d"]
+    hr_pass = m["hr_pass"]
+    ent_valid = r["r_ent_valid"]  # [NR]
+
+    def gather_t(table, idx):
+        return jnp.take(table, idx, axis=0)
+
     # first loop: per-policy carried effect (compile-time pol_eff_ctx)
     pt = c["pol_target"]
     ctx_deny = c["pol_eff_ctx"] == 2
@@ -361,6 +388,61 @@ def _evaluate_one(c: dict, r: dict):
     set_gate = set_gate & c["set_valid"]  # [S]
 
     pol_subject = ~c["pol_has_subjects"] | gather_t(hr_pass, pt)  # [S, KP]
+    return pol_gate, set_gate, pol_subject
+
+
+def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
+    """Stages F-G (pre-abort): policy-effect combination per set and the
+    last-set-wins decision; shared by both kernels."""
+    KP = contrib_present.shape[1]
+    kp_pos2 = jnp.arange(KP)[None, :]
+    p_first_deny = jnp.min(
+        jnp.where(contrib_present & (contrib_eff == 2), kp_pos2, BIG), axis=1
+    )
+    p_first_permit = jnp.min(
+        jnp.where(contrib_present & (contrib_eff == 1), kp_pos2, BIG), axis=1
+    )
+    p_first = jnp.min(jnp.where(contrib_present, kp_pos2, BIG), axis=1)
+    p_last = jnp.max(jnp.where(contrib_present, kp_pos2, -1), axis=1)
+    set_any = contrib_present.any(axis=1)
+
+    s_sel_do = jnp.where(p_first_deny < BIG, p_first_deny, p_last)
+    s_sel_po = jnp.where(p_first_permit < BIG, p_first_permit, p_last)
+    s_sel = jnp.select(
+        [c["set_ca"] == 0, c["set_ca"] == 1, c["set_ca"] == 2],
+        [s_sel_do, s_sel_po, p_first],
+        default=jnp.zeros_like(s_sel_do),
+    )
+    s_sel_c = jnp.clip(s_sel, 0, KP - 1)
+    set_eff = jnp.take_along_axis(contrib_eff, s_sel_c[:, None], axis=1)[:, 0]
+    set_cach = jnp.take_along_axis(contrib_cach, s_sel_c[:, None], axis=1)[:, 0]
+
+    # last-set-wins (reference: :293-295); effect present but neither
+    # PERMIT nor DENY folds to INDETERMINATE with the winning cacheable
+    # (reference: :312-318)
+    S = set_eff.shape[0]
+    s_pos = jnp.arange(S)
+    winner = jnp.max(jnp.where(set_any, s_pos, -1))
+    have = winner >= 0
+    winner_c = jnp.clip(winner, 0, S - 1)
+    decision = jnp.where(have, jnp.take(set_eff, winner_c), 0)
+    cacheable = jnp.where(
+        have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
+    )
+    return decision, cacheable
+
+
+def _evaluate_one(c: dict, r: dict):
+    """Decision for a single encoded request; vmapped over the batch.
+
+    ``c``: compiled policy arrays (replicated across devices).
+    ``r``: per-request encoded arrays.
+    Returns (decision, cacheable, status_code) int32 scalars where
+    decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
+    """
+    m = _match_targets(c, r)
+    reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(c, r, m)
+    pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
 
     # -------------------------------------------------- E: combine rule effects
     scope = set_gate[:, None, None] & pol_gate[:, :, None]
@@ -406,44 +488,14 @@ def _evaluate_one(c: dict, r: dict):
     contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
     contrib_cach = jnp.where(no_rules_contrib, c["pol_cacheable"], rule_cach_sel)
 
-    # ------------------------------------------------ F: combine policy effects
-    kp_pos2 = jnp.arange(KP)[None, :]
-    p_first_deny = jnp.min(
-        jnp.where(contrib_present & (contrib_eff == 2), kp_pos2, BIG), axis=1
+    # --------------------------------------- F-G: combine + last-set-wins
+    decision, cacheable = _combine_sets(
+        c, contrib_present, contrib_eff, contrib_cach
     )
-    p_first_permit = jnp.min(
-        jnp.where(contrib_present & (contrib_eff == 1), kp_pos2, BIG), axis=1
-    )
-    p_first = jnp.min(jnp.where(contrib_present, kp_pos2, BIG), axis=1)
-    p_last = jnp.max(jnp.where(contrib_present, kp_pos2, -1), axis=1)
-    set_any = contrib_present.any(axis=1)
-
-    s_sel_do = jnp.where(p_first_deny < BIG, p_first_deny, p_last)
-    s_sel_po = jnp.where(p_first_permit < BIG, p_first_permit, p_last)
-    s_sel = jnp.select(
-        [c["set_ca"] == 0, c["set_ca"] == 1, c["set_ca"] == 2],
-        [s_sel_do, s_sel_po, p_first],
-        default=jnp.zeros_like(s_sel_do),
-    )
-    s_sel_c = jnp.clip(s_sel, 0, KP - 1)
-    set_eff = jnp.take_along_axis(contrib_eff, s_sel_c[:, None], axis=1)[:, 0]
-    set_cach = jnp.take_along_axis(contrib_cach, s_sel_c[:, None], axis=1)[:, 0]
-
-    # ------------------------------------------------- G: last-set-wins + abort
-    S = set_eff.shape[0]
-    s_pos = jnp.arange(S)
-    winner = jnp.max(jnp.where(set_any, s_pos, -1))
-    have = winner >= 0
-    winner_c = jnp.clip(winner, 0, S - 1)
-    decision = jnp.where(have, jnp.take(set_eff, winner_c), 0)
-    cacheable = jnp.where(
-        have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
-    )
-    # effect present but neither PERMIT nor DENY folds to INDETERMINATE with
-    # the winning cacheable (reference: :312-318)
     status = jnp.int32(200)
 
     # condition aborts preempt everything, first in flat rule order
+    KP = coll.shape[1]
     flat_order = (
         jnp.arange(coll.shape[0])[:, None, None] * (KP * KR)
         + jnp.arange(KP)[None, :, None] * KR

@@ -1,0 +1,311 @@
+"""Rule-axis (model-parallel) sharded decision kernel.
+
+For policy trees too large to replicate per chip, rules are partitioned
+into contiguous chunks along the rule axis and distributed over the mesh's
+``model`` axis; requests stay data-parallel over ``data``.  Each device
+evaluates target matching + rule collection for its own chunk against a
+**compacted per-shard target subtable** (only the target rows its rules
+reference, plus all policy/set targets), so both hot stages shard.
+
+The reference's combining algorithms are order-sensitive (first-DENY /
+first-PERMIT / first-applicable / last-collected in insertion order,
+reference: src/core/accessController.ts:846-893), so cross-device
+combination uses **packed positional reductions**: each device reduces its
+chunk to per-(set, policy) int32 keys ``global_rule_pos * 8 + effect * 2 +
+cacheable`` and the mesh reduces with ``lax.pmin`` / ``lax.pmax`` over the
+``model`` axis — the position occupies the high bits, so ordering by key
+is ordering by rule position, and the winning rule's effect+cacheable ride
+along in the low bits.  Only ``O(S * KP)`` ints cross the ICI per request,
+never per-rule data.
+
+Condition aborts preempt in global flat rule order: a ``pmin`` over flat
+positions finds the winner, and the owning device contributes its
+code/cacheable via a max-reduction (positions are unique so exactly one
+device matches).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.compile import CompiledPolicies
+from ..ops.encode import RequestBatch
+from ..ops.kernel import (
+    BIG,
+    _combine_sets,
+    _match_targets,
+    _policy_gates,
+    _rule_predicates,
+)
+
+# target-table fields partitioned per shard (see compile.py _TargetTable)
+_T_FIELDS = [
+    "t_n_subjects", "t_role", "t_has_role", "t_scoping", "t_has_scoping",
+    "t_hr_check", "t_skip_acl", "t_sub_ids", "t_sub_vals", "t_act_ids",
+    "t_act_vals", "t_ent_vals", "t_ent_w", "t_ent_tails", "t_op_vals",
+    "t_prop_vals", "t_prop_sfx", "t_has_props", "t_n_res",
+]
+
+
+@dataclass
+class _Partitioned:
+    arrays: dict[str, np.ndarray]  # stacked [D, ...] per-shard arrays
+    kr_local: int
+    kr_offsets: np.ndarray  # [D]
+
+
+def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
+    """Slice the rule axis into contiguous chunks and compact each chunk's
+    target subtable; policy/set metadata is replicated into every shard."""
+    a = compiled.arrays
+    S, KP, KR = compiled.S, compiled.KP, compiled.KR
+    kr_local = -(-KR // n_shards)
+
+    shard_arrays: list[dict[str, np.ndarray]] = []
+    t_sizes = []
+    for d in range(n_shards):
+        # clamp: with more shards than rule columns the tail shards hold
+        # only padding (all-invalid rules)
+        lo = min(d * kr_local, KR)
+        hi = min(lo + kr_local, KR)
+        sl: dict[str, np.ndarray] = {}
+        for name in ("rule_valid", "rule_effect", "rule_cacheable_raw",
+                     "rule_cacheable_eff", "rule_has_target", "rule_target",
+                     "rule_cond"):
+            chunk = a[name][:, :, lo:hi]
+            if hi - lo < kr_local:  # pad the tail shard
+                pad_width = kr_local - (hi - lo)
+                fill = (
+                    False if chunk.dtype == bool
+                    else (0 if name in ("rule_effect", "rule_target") else -1)
+                )
+                chunk = np.concatenate(
+                    [chunk,
+                     np.full((S, KP, pad_width), fill, chunk.dtype)], axis=2
+                )
+            sl[name] = chunk
+        # compact target rows: local rule targets + all policy/set targets
+        needed = set(np.unique(sl["rule_target"][sl["rule_has_target"]]))
+        needed |= set(np.unique(a["pol_target"][a["pol_has_target"]]))
+        needed |= set(np.unique(a["set_target"][a["set_has_target"]]))
+        needed.add(0)  # row 0 backs the "no target" index
+        order = sorted(needed)
+        remap = np.zeros(a["t_role"].shape[0], np.int64)
+        for new, old in enumerate(order):
+            remap[old] = new
+        for name in _T_FIELDS:
+            sl[name] = a[name][order]
+        sl["rule_target"] = remap[sl["rule_target"]].astype(np.int32)
+        sl["pol_target"] = remap[a["pol_target"]].astype(np.int32)
+        sl["set_target"] = remap[a["set_target"]].astype(np.int32)
+        shard_arrays.append(sl)
+        t_sizes.append(len(order))
+
+    t_max = max(t_sizes)
+    for sl in shard_arrays:
+        t_have = sl["t_role"].shape[0]
+        if t_have < t_max:  # pad subtables to a common T (repeat row 0)
+            for name in _T_FIELDS:
+                reps = np.repeat(sl[name][:1], t_max - t_have, axis=0)
+                sl[name] = np.concatenate([sl[name], reps], axis=0)
+
+    # replicate policy/set metadata into the stacked layout
+    replicated = [
+        "set_valid", "set_ca", "set_has_target", "pol_valid", "pol_ca",
+        "pol_effect", "pol_cacheable", "pol_has_target", "pol_has_subjects",
+        "pol_n_rules", "pol_eff_ctx", "pol_has_props", "pol_ent_vals",
+    ]
+    stacked: dict[str, np.ndarray] = {}
+    for name in list(shard_arrays[0]):
+        stacked[name] = np.stack([sl[name] for sl in shard_arrays])
+    for name in replicated:
+        stacked[name] = np.stack([a[name]] * n_shards)
+    return _Partitioned(
+        arrays=stacked,
+        kr_local=kr_local,
+        kr_offsets=np.arange(n_shards, dtype=np.int32) * kr_local,
+    )
+
+
+def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
+    """Per-device evaluation of one rule chunk for one request, with
+    cross-``model`` packed positional reductions.  Stages A-D reuse the
+    single-device kernel helpers against this shard's compacted target
+    subtable; only rule collection (E) and the abort scan differ."""
+    m = _match_targets(c, r)
+    reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(c, r, m)
+    pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
+
+    # ---- rule collection on the local chunk
+    scope = set_gate[:, None, None] & pol_gate[:, :, None]
+    abort_rule = reached & has_cond & cond_a & scope
+    matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
+    coll = matches & pol_subject[:, :, None] & scope  # [S, KP, KR_local]
+
+    KRl = coll.shape[2]
+    # GLOBAL rule positions inside each (set, policy)
+    pos = (kr_offset + jnp.arange(KRl))[None, None, :]
+    payload = c["rule_effect"] * 2 + c["rule_cacheable_eff"].astype(jnp.int32)
+    BIGKEY = jnp.int32(2_000_000_000)
+
+    def pmin_key(mask):
+        local = jnp.min(jnp.where(mask, pos * 8 + payload, BIGKEY), axis=2)
+        return jax.lax.pmin(local, model_axis)
+
+    def pmax_key(mask):
+        local = jnp.max(jnp.where(mask, (pos + 1) * 8 + payload, 0), axis=2)
+        return jax.lax.pmax(local, model_axis)
+
+    k_first_deny = pmin_key(coll & (c["rule_effect"] == 2))
+    k_first_permit = pmin_key(coll & (c["rule_effect"] == 1))
+    k_first = pmin_key(coll)
+    k_last = pmax_key(coll)
+    any_coll = k_last > 0
+
+    # k_last packs pos+1; subtracting 8 aligns its payload with the pmin
+    # packing so one unpack below serves both branches
+    sel_key_do = jnp.where(k_first_deny < BIGKEY,
+                           k_first_deny, jnp.where(any_coll, k_last - 8, 0))
+    sel_key_po = jnp.where(k_first_permit < BIGKEY,
+                           k_first_permit, jnp.where(any_coll, k_last - 8, 0))
+    sel_key_fa = jnp.where(k_first < BIGKEY, k_first, 0)
+    sel_key = jnp.select(
+        [c["pol_ca"] == 0, c["pol_ca"] == 1, c["pol_ca"] == 2],
+        [sel_key_do, sel_key_po, sel_key_fa],
+        default=jnp.zeros_like(sel_key_do),
+    )
+    rule_eff_sel = (sel_key // 2) % 4
+    rule_cach_sel = sel_key % 2
+
+    no_rules_contrib = (
+        c["pol_valid"]
+        & set_gate[:, None]
+        & pol_gate
+        & (c["pol_n_rules"] == 0)
+        & (c["pol_effect"] > 0)
+    )
+    contrib_present = no_rules_contrib | any_coll
+    contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
+    contrib_cach = jnp.where(
+        no_rules_contrib, c["pol_cacheable"], rule_cach_sel.astype(bool)
+    )
+
+    # ---- combine policy effects + last-set-wins (identical on every
+    # device after the reductions)
+    decision, cacheable = _combine_sets(
+        c, contrib_present, contrib_eff, contrib_cach
+    )
+    status = jnp.int32(200)
+
+    # ---- condition aborts: first in GLOBAL flat rule order
+    S, KPn = coll.shape[0], coll.shape[1]
+    flat_order = (
+        jnp.arange(S)[:, None, None] * (KPn * kr_total)
+        + jnp.arange(KPn)[None, :, None] * kr_total
+        + (kr_offset + jnp.arange(KRl))[None, None, :]
+    )
+    local_abort_pos = jnp.min(jnp.where(abort_rule, flat_order, BIG))
+    abort_pos = jax.lax.pmin(local_abort_pos, model_axis)
+    has_abort = abort_pos < BIG
+    # exactly one device owns the winning position (positions are unique),
+    # so max-reductions broadcast its code/cacheable
+    i_own = (local_abort_pos == abort_pos) & has_abort
+    abort_flat = jnp.argmin(jnp.where(abort_rule, flat_order, BIG))
+    code_local = jnp.where(
+        i_own, jnp.take(cond_c.reshape(-1), abort_flat), 0
+    )
+    cach_local = jnp.where(
+        i_own,
+        jnp.take(c["rule_cacheable_raw"].reshape(-1), abort_flat).astype(
+            jnp.int32
+        ) + 1,
+        0,
+    )
+    abort_code = jax.lax.pmax(code_local, model_axis)
+    abort_cach = jax.lax.pmax(cach_local, model_axis) - 1
+
+    decision = jnp.where(has_abort, 2, decision)
+    cacheable = jnp.where(has_abort, abort_cach, cacheable)
+    status = jnp.where(has_abort, abort_code, status)
+
+    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+
+
+class RuleShardedKernel:
+    """Two-axis sharded kernel: requests over ``data``, rules over
+    ``model``; per-shard compacted target subtables; ICI traffic is the
+    per-(set, policy) packed keys only."""
+
+    def __init__(self, compiled: CompiledPolicies, mesh: Mesh,
+                 data_axis: str = "data", model_axis: str = "model"):
+        if not compiled.supported:
+            raise ValueError(
+                f"policy tree unsupported: {compiled.unsupported_reason}"
+            )
+        self.compiled = compiled
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.n_data = mesh.shape[data_axis]
+        self.n_model = mesh.shape[model_axis]
+
+        part = partition_rules(compiled, self.n_model)
+        self._kr_total = part.kr_local * self.n_model
+        self._c = {
+            k: jax.device_put(
+                jnp.asarray(v), NamedSharding(mesh, P(model_axis))
+            )
+            for k, v in part.arrays.items()
+        }
+        self._offsets = jax.device_put(
+            jnp.asarray(part.kr_offsets), NamedSharding(mesh, P(model_axis))
+        )
+        kr_total = self._kr_total
+
+        from jax.experimental.shard_map import shard_map
+
+        c_specs = {k: P(model_axis) for k in self._c}
+
+        def run(c, offsets, batch_arrays, rgx_set, pfx_neq):
+            c_local = {k: v[0] for k, v in c.items()}
+            kr_offset = offsets[0]
+
+            def one(ra):
+                rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq}
+                return _evaluate_chunk(
+                    c_local, rr, kr_offset, kr_total, model_axis
+                )
+
+            return jax.vmap(one)(batch_arrays)
+
+        self._run = jax.jit(
+            shard_map(
+                run,
+                mesh=mesh,
+                in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
+                out_specs=(P(data_axis), P(data_axis), P(data_axis)),
+                check_rep=False,
+            )
+        )
+
+    def evaluate(self, batch: RequestBatch):
+        arrays = dict(batch.arrays)
+        arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
+        arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
+        arrays["cond_code"] = np.ascontiguousarray(batch.cond_code.T)
+        from .mesh import pad_batch
+
+        arrays, _ = pad_batch(arrays, batch.B, self.n_data)
+        out = self._run(
+            self._c,
+            self._offsets,
+            {k: jnp.asarray(v) for k, v in arrays.items()},
+            jnp.asarray(batch.rgx_set),
+            jnp.asarray(batch.pfx_neq),
+        )
+        return tuple(np.asarray(x)[: batch.B] for x in out)
