@@ -33,7 +33,11 @@ from .compile import CompiledPolicies
 from .encode import RequestBatch
 from .interner import ABSENT
 
-BIG = jnp.int32(1 << 30)
+# plain numpy scalar (not jnp): creating a device array at module scope
+# would initialize the jax backend as an import side effect — on hosts whose
+# TPU plugin is unreachable, that hangs every importer, including host-only
+# code paths that never run the kernel
+BIG = np.int32(1 << 30)
 
 # Policy trees whose compiled tensors fit under this size are baked into the
 # jitted program as XLA constants (the compiler pre-folds every
@@ -46,6 +50,23 @@ CONSTANT_BAKE_LIMIT_BYTES = 1 << 20
 def bake_policy_constants(compiled: CompiledPolicies) -> bool:
     policy_bytes = sum(np.asarray(v).nbytes for v in compiled.arrays.values())
     return policy_bytes <= CONSTANT_BAKE_LIMIT_BYTES
+
+
+def pow2_bucket(n: int, floor: int = 8) -> int:
+    """Smallest power of two >= n (min `floor`): the shared padding bucket
+    used by every kernel entry so varying batch/entity sizes reuse a
+    handful of compiled programs instead of one XLA compile per size."""
+    return max(floor, 1 << max(n - 1, 1).bit_length())
+
+
+def pad_cols(a: np.ndarray, width: int) -> np.ndarray:
+    """Zero-pad the second axis out to `width` (conditions are [n_cond, B];
+    regex matrices are [W, E])."""
+    a = np.asarray(a)
+    if a.shape[1] == width:
+        return a
+    fill = np.zeros(a.shape[:1] + (width - a.shape[1],), a.dtype)
+    return np.concatenate([a, fill], axis=1)
 
 
 def _pairs_subset(rule_ids, rule_vals, req_ids, req_vals):
@@ -559,7 +580,7 @@ class DecisionKernel:
         every call.  Rows are independent under vmap, so zero-padded rows
         cannot affect real rows; their outputs are sliced away."""
         b = batch.arrays[next(iter(batch.arrays))].shape[0]
-        bucket = max(8, 1 << max(b - 1, 1).bit_length())
+        bucket = pow2_bucket(b)
 
         def pad_lead(a: np.ndarray) -> np.ndarray:
             a = np.asarray(a)
@@ -568,18 +589,9 @@ class DecisionKernel:
             fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
             return np.concatenate([a, fill], axis=0)
 
-        def pad_cols(a: np.ndarray, width: int) -> np.ndarray:
-            # conditions are [n_cond, B]; regex matrices are [W, E]
-            a = np.asarray(a)
-            if a.shape[1] == width:
-                return a
-            fill = np.zeros(a.shape[:1] + (width - a.shape[1],), a.dtype)
-            return np.concatenate([a, fill], axis=1)
-
         # distinct-entity count also varies per batch; bucket it too so the
         # regex matrices keep a stable compiled shape
-        e = batch.rgx_set.shape[1]
-        e_bucket = max(8, 1 << max(e - 1, 1).bit_length())
+        e_bucket = pow2_bucket(batch.rgx_set.shape[1])
 
         out = self._run(
             {k: jnp.asarray(pad_lead(v)) for k, v in batch.arrays.items()},

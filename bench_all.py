@@ -19,9 +19,23 @@ benchmark (config 2); this file is the evidence matrix:
 5. ``stress-100k``     — synthetic ~100k-rule tree (nested deny+permit-
                          overrides), large tiled request batch, chunked
                          device evaluation.
+6. ``hr-deep``         — role-scoped policies with DEEP hierarchical-scope
+                         trees (depth 4-7): measures the kernel
+                         eligibility rate under realistic org trees in
+                         addition to throughput.
+
+Every kernel config reports ``eligible_pct`` (fraction of the batch served
+on device; ineligible rows fall back to the scalar oracle).
+
+The jax-dependent configs are gated on an out-of-process backend probe
+(bench.probe_backend): when the accelerator hangs or fails to initialize,
+only the host-side configs run and a ``tpu backend status`` row records the
+error — existing good rows in BENCH_ALL.json are never overwritten with
+zeros.
 
 Environment knobs: BENCH_BATCH (config 2 total), STRESS_RULES,
-STRESS_TOTAL, STRESS_CHUNK, SCALAR_N, WIA_N.
+STRESS_TOTAL, STRESS_CHUNK, SCALAR_N, WIA_N, BENCH_PLATFORM=cpu (force CPU
+backend), BENCH_SKIP_PROBE=1, BENCH_PROBE_TIMEOUT, BENCH_PROBE_RETRIES.
 """
 
 from __future__ import annotations
@@ -132,6 +146,8 @@ def bench_tpu_batched():
 
     import bench
 
+    # main() already gated on the probe; don't pay for a second one
+    os.environ["BENCH_SKIP_PROBE"] = "1"
     buf = io.StringIO()
     with redirect_stdout(buf):
         bench.main()
@@ -253,7 +269,114 @@ def bench_hr_conditions():
         "isAllowed decisions/sec/chip (role scopes + conditions fixtures)",
         base * iters / elapsed,
         "decisions/s",
-        {"batch": base, "eligible": n_eligible},
+        {"batch": base, "eligible": n_eligible,
+         "eligible_pct": round(100.0 * n_eligible / base, 1)},
+    )
+
+
+# --------------------------------------------- config 6: deep HR-scope trees
+
+
+def _deep_hr_tree(rng, depth: int, branch_p: float, role: str):
+    """Chain of orgs root->leaf with probabilistic side branches: the shape
+    of a real org hierarchy (the reference's fixtures top out at depth 4,
+    test/utils.ts:256-276; production trees go deeper). Returns
+    (tree, node_ids) so callers can target interior/leaf nodes."""
+    node_ids = []
+
+    def node(d):
+        me = {"id": f"org-{len(node_ids) + 1}-{d}"}
+        node_ids.append(me["id"])
+        if d < depth:
+            kids = [node(d + 1)]
+            while rng.random() < branch_p and len(kids) < 3:
+                kids.append(node(d + 1))
+            me["children"] = kids
+        return me
+
+    tree = node(0)
+    tree["role"] = role
+    return [tree], node_ids
+
+
+def bench_hr_deep():
+    import jax
+    import jax.numpy as jnp
+
+    from access_control_srv_tpu.core import AccessController, populate
+    from access_control_srv_tpu.ops import (
+        DecisionKernel,
+        compile_policies,
+        encode_requests,
+    )
+    from tests.utils import build_request
+
+    engine = AccessController()
+    populate(engine, os.path.join(REPO, "tests", "fixtures", "role_scopes.yml"))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    kernel = DecisionKernel(compiled)
+
+    LOC = "urn:restorecommerce:acs:model:location.Location"
+    base = int(os.environ.get("HRDEEP_N", 2048))
+    rng = np.random.default_rng(11)
+    requests = []
+    node_counts = []
+    for i in range(base):
+        role = ["member", "manager"][i % 2]
+        depth = int(rng.integers(4, 8))
+        tree, node_ids = _deep_hr_tree(rng, depth, branch_p=0.35, role=role)
+        node_counts.append(len(node_ids))
+        # scoping instance = root; owner = a RANDOM node in the tree for
+        # ~75% of requests (exercises descent to interior/leaf depth), an
+        # unrelated org otherwise
+        in_scope = rng.random() < 0.75
+        owner = node_ids[int(rng.integers(len(node_ids)))] if in_scope \
+            else f"org-{int(rng.integers(1, len(node_ids) + 1))}-x"
+        requests.append(
+            build_request(
+                subject_id=f"user-{i % 64}",
+                subject_role=role,
+                role_scoping_entity=ORG,
+                role_scoping_instance=tree[0]["id"],
+                resource_type=LOC,
+                resource_id=f"L{i}",
+                action_type=(
+                    "urn:restorecommerce:acs:names:action:read"
+                    if i % 2 == 0
+                    else "urn:restorecommerce:acs:names:action:modify"
+                ),
+                owner_indicatory_entity=ORG,
+                owner_instance=owner,
+                hierarchical_scopes=tree,
+            )
+        )
+    batch = encode_requests(requests, compiled)
+    n_eligible = int(batch.eligible.sum())
+    args = (
+        {k: jnp.asarray(v) for k, v in batch.arrays.items()},
+        jnp.asarray(batch.rgx_set),
+        jnp.asarray(batch.pfx_neq),
+        jnp.asarray(batch.cond_true),
+        jnp.asarray(batch.cond_abort),
+        jnp.asarray(batch.cond_code),
+    )
+    out = kernel._run(*args)
+    jax.block_until_ready(out)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel._run(*args)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        "isAllowed decisions/sec/chip (deep HR-scope trees, depth 4-7)",
+        base * iters / elapsed,
+        "decisions/s",
+        {"batch": base, "eligible": n_eligible,
+         "eligible_pct": round(100.0 * n_eligible / base, 1),
+         "mean_tree_nodes": round(float(np.mean(node_counts)), 1),
+         "max_tree_nodes": int(np.max(node_counts))},
     )
 
 
@@ -404,29 +527,74 @@ def bench_stress():
         base * iters / elapsed,
         "decisions/s",
         {"rules": actual_rules, "batch": base, "iters": iters,
-         "host_compile_s": round(compile_s, 2)},
+         "host_compile_s": round(compile_s, 2),
+         "eligible_pct": round(100.0 * float(batch.eligible.mean()), 1)},
     )
+
+
+HOST_ONLY = {"scalar", "wia"}
 
 
 def main():
     # BENCH_PLATFORM=cpu forces the CPU backend (the machine pins
     # JAX_PLATFORMS=axon externally, so the env var alone cannot override
     # it — jax.config must be set before the first backend touch)
+    backend_row = None
     if os.environ.get("BENCH_PLATFORM") == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    which = sys.argv[1:] or ["scalar", "batched", "wia", "hr", "stress"]
+        backend = "cpu"
+    elif os.environ.get("BENCH_SKIP_PROBE") == "1":
+        backend = "unprobed"
+    else:
+        from bench import probe_backend
+
+        info, err = probe_backend()
+        backend = info["backend"] if info else None
+        if info is None:
+            backend_row = {
+                "metric": "tpu backend status",
+                "value": 0.0,
+                "unit": "up",
+                "vs_baseline": 0.0,
+                "error": err,
+            }
+            print(json.dumps(backend_row), file=sys.stderr, flush=True)
+        else:
+            backend_row = {
+                "metric": "tpu backend status",
+                "value": 1.0,
+                "unit": "up",
+                "vs_baseline": 1.0,
+                "backend": backend,
+                "device0": info.get("device0"),
+            }
+
+    which = sys.argv[1:] or ["scalar", "batched", "wia", "hr", "hr-deep",
+                             "stress"]
+    if backend is None:
+        skipped = [name for name in which if name not in HOST_ONLY]
+        which = [name for name in which if name in HOST_ONLY]
+        print(
+            f"accelerator unavailable; skipping {skipped} "
+            "(existing rows preserved)",
+            file=sys.stderr,
+        )
     rows = []
     fns = {
         "scalar": bench_scalar_cpu,
         "batched": bench_tpu_batched,
         "wia": bench_what_is_allowed,
         "hr": bench_hr_conditions,
+        "hr-deep": bench_hr_deep,
         "stress": bench_stress,
     }
     for name in which:
-        rows.append(fns[name]())
+        row = fns[name]()
+        if name not in HOST_ONLY:
+            row.setdefault("backend", backend)
+        rows.append(row)
     # merge by metric name so partial runs refresh their rows without
     # clobbering the rest of the evidence matrix
     path = os.path.join(REPO, "BENCH_ALL.json")
@@ -435,6 +603,8 @@ def main():
         with open(path) as fh:
             for row in json.load(fh):
                 merged[row["metric"]] = row
+    if backend_row is not None:
+        merged[backend_row["metric"]] = backend_row
     for row in rows:
         merged[row["metric"]] = row
     with open(path, "w") as fh:
