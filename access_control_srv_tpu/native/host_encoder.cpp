@@ -109,6 +109,13 @@ struct JsonParser {
   const char* p;
   const char* end;
   bool ok = true;
+  int depth = 0;
+  // gRPC payloads are attacker-controlled: without a cap, one nested
+  // object/array per stack frame overflows the C stack well under the
+  // message size limit. Past the cap the row goes ineligible and is served
+  // by the (recursion-safe) Python fallback. The cap also bounds JValue
+  // destructor recursion, since the DOM can never get deeper than this.
+  static constexpr int kMaxDepth = 64;
 
   explicit JsonParser(std::string_view s) : p(s.data()), end(s.data() + s.size()) {}
 
@@ -133,10 +140,11 @@ struct JsonParser {
     if (p >= end) { ok = false; return v; }
     char c = *p;
     if (c == '{') {
+      if (++depth > kMaxDepth) { ok = false; return v; }
       ++p;
       v.kind = JValue::Obj;
       skip_ws();
-      if (p < end && *p == '}') { ++p; return v; }
+      if (p < end && *p == '}') { ++p; --depth; return v; }
       while (ok) {
         skip_ws();
         if (p >= end || *p != '"') { ok = false; break; }
@@ -150,11 +158,13 @@ struct JsonParser {
         if (p < end && *p == '}') { ++p; break; }
         ok = false;
       }
+      --depth;
     } else if (c == '[') {
+      if (++depth > kMaxDepth) { ok = false; return v; }
       ++p;
       v.kind = JValue::Arr;
       skip_ws();
-      if (p < end && *p == ']') { ++p; return v; }
+      if (p < end && *p == ']') { ++p; --depth; return v; }
       while (ok) {
         v.arr.push_back(parse_value());
         skip_ws();
@@ -162,6 +172,7 @@ struct JsonParser {
         if (p < end && *p == ']') { ++p; break; }
         ok = false;
       }
+      --depth;
     } else if (c == '"') {
       v.kind = JValue::Str;
       v.str = parse_string_raw();
@@ -200,13 +211,20 @@ struct JsonParser {
     return v;
   }
   std::string parse_string_raw() {
-    // assumes *p == '"'
+    // assumes *p == '"'. Strict: any input json.loads would reject
+    // (unterminated string, unknown or truncated escape, non-hex \uXXXX,
+    // raw control character) sets ok=false so the row falls back to the
+    // Python path instead of serving a decision computed from a misparse.
     ++p;
     std::string out;
     while (p < end && *p != '"') {
-      if (*p == '\\' && p + 1 < end) {
+      if (*p == '\\') {
+        if (p + 1 >= end) { ok = false; return out; }  // truncated escape
         ++p;
         switch (*p) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
           case 'n': out.push_back('\n'); break;
           case 't': out.push_back('\t'); break;
           case 'r': out.push_back('\r'); break;
@@ -214,37 +232,48 @@ struct JsonParser {
           case 'f': out.push_back('\f'); break;
           case 'u': {
             // \uXXXX -> UTF-8 (no surrogate-pair handling; URNs are ASCII)
-            if (end - p >= 5) {
-              unsigned code = 0;
-              for (int i = 1; i <= 4; ++i) {
-                char h = p[i];
-                code <<= 4;
-                if (h >= '0' && h <= '9') code |= h - '0';
-                else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
-                else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
-              }
-              p += 4;
-              if (code < 0x80) out.push_back((char)code);
-              else if (code < 0x800) {
-                out.push_back((char)(0xC0 | (code >> 6)));
-                out.push_back((char)(0x80 | (code & 0x3F)));
-              } else {
-                out.push_back((char)(0xE0 | (code >> 12)));
-                out.push_back((char)(0x80 | ((code >> 6) & 0x3F)));
-                out.push_back((char)(0x80 | (code & 0x3F)));
-              }
+            if (end - p < 5) { ok = false; return out; }
+            unsigned code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char h = p[i];
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else { ok = false; return out; }  // non-hex digit
+            }
+            p += 4;
+            if (code >= 0xD800 && code <= 0xDFFF) {
+              // surrogate range: json.loads decodes pairs (and even lone
+              // surrogates) with semantics this 3-byte encoder does not
+              // reproduce — fall back rather than serve from a misparse
+              ok = false;
+              return out;
+            }
+            if (code < 0x80) out.push_back((char)code);
+            else if (code < 0x800) {
+              out.push_back((char)(0xC0 | (code >> 6)));
+              out.push_back((char)(0x80 | (code & 0x3F)));
+            } else {
+              out.push_back((char)(0xE0 | (code >> 12)));
+              out.push_back((char)(0x80 | ((code >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (code & 0x3F)));
             }
             break;
           }
-          default: out.push_back(*p);
+          default: ok = false; return out;  // unknown escape
         }
         ++p;
+      } else if ((unsigned char)*p < 0x20) {
+        ok = false;  // raw control character: json.loads rejects
+        return out;
       } else {
         out.push_back(*p);
         ++p;
       }
     }
-    if (p < end) ++p;  // closing quote
+    if (p >= end) { ok = false; return out; }  // unterminated string
+    ++p;  // closing quote
     return out;
   }
 };

@@ -267,7 +267,7 @@ class RuleShardedKernel:
         )
         kr_total = self._kr_total
 
-        from jax.experimental.shard_map import shard_map
+        shard_map = jax.shard_map
 
         c_specs = {k: P(model_axis) for k in self._c}
 
@@ -289,23 +289,37 @@ class RuleShardedKernel:
                 mesh=mesh,
                 in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
                 out_specs=(P(data_axis), P(data_axis), P(data_axis)),
-                check_rep=False,
+                check_vma=False,
             )
         )
 
     def evaluate(self, batch: RequestBatch):
+        """Batch and regex-matrix axes are padded to power-of-two buckets
+        (divisible by the data-axis size) before entering jit — the same
+        scheme as DecisionKernel.evaluate, so serving traffic with varying
+        batch sizes reuses a handful of compiled programs instead of
+        triggering a fresh XLA compile per distinct size."""
         arrays = dict(batch.arrays)
         arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
         arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
         arrays["cond_code"] = np.ascontiguousarray(batch.cond_code.T)
         from .mesh import pad_batch
 
-        arrays, _ = pad_batch(arrays, batch.B, self.n_data)
+        # bucket = n_data * next_pow2(ceil(B / n_data)): stable shapes AND
+        # even sharding across the data axis
+        from ..ops.kernel import pad_cols, pow2_bucket
+
+        per_shard = -(-batch.B // self.n_data)
+        bucket = self.n_data * pow2_bucket(per_shard)
+        arrays, _ = pad_batch(arrays, batch.B, bucket)
+
+        e_bucket = pow2_bucket(batch.rgx_set.shape[1])
+
         out = self._run(
             self._c,
             self._offsets,
             {k: jnp.asarray(v) for k, v in arrays.items()},
-            jnp.asarray(batch.rgx_set),
-            jnp.asarray(batch.pfx_neq),
+            jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
+            jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
         )
         return tuple(np.asarray(x)[: batch.B] for x in out)

@@ -121,13 +121,20 @@ class AccessControlService:
                 ),
             )
 
-    def is_allowed_batch(self, requests: list) -> list[Response]:
+    def is_allowed_batch(
+        self, requests: list, observe: bool = True
+    ) -> list[Response]:
+        # observe=False lets a caller that does its own per-RPC telemetry
+        # (the raw-bytes gRPC fast path serving fallback rows through here)
+        # suppress this layer's histogram/counter updates so no request is
+        # double-counted
         t0 = time.perf_counter()
+        _observe = self._observe if observe else (lambda *a, **k: None)
         try:
             reqs = [coerce_request(r) for r in requests]
         except Exception as err:
-            self._observe("batch_latency", t0,
-                          [Decision.DENY] * len(requests))
+            _observe("batch_latency", t0,
+                     [Decision.DENY] * len(requests))
             code = getattr(err, "code", 500)
             status = OperationStatus(
                 code=code if isinstance(code, int) else 500, message=str(err)
@@ -141,14 +148,14 @@ class AccessControlService:
                 responses = self.evaluator.is_allowed_batch(reqs)
             else:
                 responses = [self.engine.is_allowed(r) for r in reqs]
-            self._observe("batch_latency", t0,
-                          [r.decision for r in responses])
+            _observe("batch_latency", t0,
+                     [r.decision for r in responses])
             return responses
         except Exception as err:
             # same deny-on-exception contract as the single-request path
             if self.logger:
                 self.logger.exception("isAllowedBatch failed")
-            self._observe("batch_latency", t0, [Decision.DENY] * len(reqs))
+            _observe("batch_latency", t0, [Decision.DENY] * len(reqs))
             code = getattr(err, "code", 500)
             status = OperationStatus(
                 code=code if isinstance(code, int) else 500,

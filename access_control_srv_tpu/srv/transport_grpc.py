@@ -390,9 +390,13 @@ class GrpcServer:
                             ),
                         )
                     if fallback_reqs:
+                        # observe=False: this handler records batch_latency
+                        # and decision counts for ALL rows below
                         for b, resp in zip(
                             fallback_rows,
-                            worker.service.is_allowed_batch(fallback_reqs),
+                            worker.service.is_allowed_batch(
+                                fallback_reqs, observe=False
+                            ),
                         ):
                             responses[b] = response_to_pb(resp)
                     telemetry = getattr(worker, "telemetry", None)

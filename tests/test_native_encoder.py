@@ -249,3 +249,77 @@ def test_trailing_garbage_json_rejected():
         messages.append(msg.SerializeToString())
     nb = enc.encode_wire(messages)
     assert not nb.eligible.any()
+
+
+def test_deeply_nested_json_no_stack_overflow():
+    """A JSON nesting bomb (well under the gRPC message cap) must not
+    overflow the C stack -- past the parser depth cap the row goes
+    ineligible and falls back to the Python path."""
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+
+    good = wire_roundtrip(grid_requests(n=1, seed=5))[0][0]
+    messages = []
+    # the {"id": ...} wrapper itself consumes one depth level, so inner
+    # array depth 63 hits the cap (64) exactly and 64 exceeds it
+    for depth in (30, 63, 64, 200_000):
+        bomb = b"[" * depth + b"]" * depth
+        msg = pb.Request.FromString(good)
+        msg.context.subject.value = b'{"id": ' + bomb + b"}"
+        messages.append(msg.SerializeToString())
+    nb = enc.encode_wire(messages)
+    # depths under the cap parse fine; past the cap the row is ineligible
+    assert nb.eligible[0] and nb.eligible[1]
+    assert not nb.eligible[2]
+    assert not nb.eligible[3]
+
+
+def test_strict_string_parsing_matches_json_loads():
+    """Strings json.loads rejects must make the row ineligible, never
+    silently decode to garbage and serve a decision from a misparse."""
+    import json as _json
+
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    engine = make_engine("basic_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+    good = wire_roundtrip(grid_requests(n=1, seed=5))[0][0]
+
+    bad = [
+        b'{"id": "unterminated',       # no closing quote
+        b'{"id": "trunc\\u12"}',       # truncated \uXXXX
+        b'{"id": "bad\\uzzzz"}',       # non-hex \uXXXX
+        b'{"id": "esc\\x41"}',         # unknown escape
+        b'{"id": "ctl\x01char"}',      # raw control character
+        b'{"id": "end\\',              # escape at end of input
+    ]
+    # json.loads ACCEPTS these, but the native path cannot reproduce
+    # Python's surrogate decoding — it must fall back (conservatively
+    # ineligible) rather than emit CESU-8 and serve from a misparse
+    conservative = [
+        b'{"id": "pair\\ud83d\\ude00"}',
+        b'{"id": "lone\\ud800"}',
+    ]
+    ok = [
+        b'{"id": "fine\\u0041\\n\\"q\\\\"}',
+        b'{"id": "slash\\/ok"}',
+    ]
+    for payload in bad:
+        with pytest.raises(Exception):
+            _json.loads(payload.decode("utf-8", "surrogateescape"))
+    for payload in conservative + ok:
+        _json.loads(payload.decode())
+
+    messages = []
+    for payload in bad + conservative + ok:
+        msg = pb.Request.FromString(good)
+        msg.context.subject.value = payload
+        messages.append(msg.SerializeToString())
+    nb = enc.encode_wire(messages)
+    n_ineligible = len(bad) + len(conservative)
+    assert not nb.eligible[:n_ineligible].any()
+    assert nb.eligible[n_ineligible:].all()
