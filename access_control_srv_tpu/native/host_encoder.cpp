@@ -33,6 +33,7 @@ constexpr int32_t ABSENT = -1;
 // padding caps -- must match ops/encode.py
 constexpr int NR = 4, NI = 4, NP = 8, NSUB = 8, NACT = 4, NOP = 2;
 constexpr int NOWN = 4, NRA = 8, NHR = 32, NROLE = 4;
+constexpr int NACLE = 4, NACLI = 8, NHRR = 8;  // must match ops/encode.py
 
 // ------------------------------------------------------------- interner
 
@@ -426,6 +427,7 @@ struct Encoder {
   int32_t urn_role, urn_scoping, urn_scoping_inst, urn_owner_ent, urn_owner_inst;
   int32_t urn_action_id;
   int32_t crud[4];
+  int32_t urn_acl_ind, urn_acl_inst;
   bool tails_ambiguous = false;
   std::vector<std::string> vocab_tails;  // tail strings of entity vocab
   // relevance cache keyed by "<tail idx>\x1f<prop value>"
@@ -466,6 +468,12 @@ struct OutArrays {
   uint8_t* r_has_target;     // [B]
   uint8_t* r_has_idop;       // [B]
   uint8_t* r_action_crud;    // [B]
+  int32_t* r_acl_short;      // [B] 0 pairs / 1 early all-clear / 2 malformed
+  int32_t* r_acl_ent;        // [B, NACLE]
+  int32_t* r_acl_inst;       // [B, NACLE, NACLI]
+  int32_t* r_acl_hr;         // [B, NHR, 2] verifyACL flatten (role, org)
+  int32_t* r_hr_roles;       // [B, NHRR] distinct verifyACL-flatten roles
+  int32_t* r_subject_id;     // [B]
   uint8_t* eligible;         // [B]
   int32_t* batch_entities;   // [B * NR] distinct entity interner ids out
 };
@@ -518,6 +526,45 @@ bool encode_owners(Encoder& enc, const JValue* owners, int32_t* ent_out,
   return true;
 }
 
+// verifyACL's role->org flatten: true pre-order with per-node role
+// override (mirrors encode.py:_flatten_acl_hr; reference:
+// verifyACL.ts:119-129). Recursion depth is bounded by the JSON parser's
+// depth cap. Dedups (role, org) pairs and records distinct non-null role
+// keys in first-occurrence order (the create-path scan is order-sensitive).
+void flatten_acl_hr(Encoder& enc, const JValue* nodes, bool has_role,
+                    std::string_view role_sv,
+                    std::vector<std::array<int32_t, 2>>& pairs,
+                    std::vector<int32_t>& role_order) {
+  if (nodes == nullptr || nodes->kind != JValue::Arr) return;
+  for (const JValue& node : nodes->arr) {
+    const JValue* role = node.get("role");
+    bool node_has_role = has_role;
+    std::string_view node_role = role_sv;
+    if (role != nullptr && role->kind == JValue::Str) {
+      node_has_role = true;
+      node_role = role->str;
+    }
+    std::string_view node_id = jstr(node.get("id"));
+    if (!node_id.empty()) {
+      // intern ONLY when a pair is appended, role before org — the exact
+      // interning order of the Python encoder, so lazily-assigned ids for
+      // novel strings stay identical across both encoders
+      int32_t rid = node_has_role ? enc.interner.intern(node_role) : ABSENT;
+      std::array<int32_t, 2> entry = {rid, enc.interner.intern(node_id)};
+      bool seen = false;
+      for (auto& existing : pairs) seen |= existing == entry;
+      if (!seen) pairs.push_back(entry);
+      if (rid != ABSENT) {
+        bool have = false;
+        for (int32_t r : role_order) have |= r == rid;
+        if (!have) role_order.push_back(rid);
+      }
+    }
+    flatten_acl_hr(enc, node.get("children"), node_has_role, node_role,
+                   pairs, role_order);
+  }
+}
+
 // find_ctx_resource: wrapped instance id first, then direct id
 // (mirrors core/common.py:find_ctx_resource)
 const JValue* find_ctx_resource(const std::vector<JValue>& resources,
@@ -539,7 +586,8 @@ extern "C" {
 // strings: concatenated UTF-8; offs[n+1] boundaries.  urn_ids order:
 // [entity, property, operation, resourceID, role, roleScopingEntity,
 //  roleScopingInstance, ownerEntity, ownerInstance, actionID,
-//  create, read, modify, delete]  (indices into the preloaded strings)
+//  create, read, modify, delete, aclIndicatoryEntity, aclInstance]
+// (indices into the preloaded strings)
 // vocab_tail_ids: tail interner ids of the entity vocab (W entries).
 void* acs_enc_create(const char* strings, const int64_t* offs, int32_t n,
                      const int32_t* urn_ids, int32_t tails_ambiguous,
@@ -564,6 +612,8 @@ void* acs_enc_create(const char* strings, const int64_t* offs, int32_t n,
   enc->urn_owner_inst = urn_ids[8];
   enc->urn_action_id = urn_ids[9];
   for (int i = 0; i < 4; ++i) enc->crud[i] = urn_ids[10 + i];
+  enc->urn_acl_ind = urn_ids[14];
+  enc->urn_acl_inst = urn_ids[15];
   enc->tails_ambiguous = tails_ambiguous != 0;
   for (int32_t w = 0; w < W; ++w)
     enc->vocab_tails.push_back(enc->interner.strings[vocab_tail_ids[w]]);
@@ -626,6 +676,12 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
   o.r_has_target = (uint8_t*)ptrs[pi++];
   o.r_has_idop = (uint8_t*)ptrs[pi++];
   o.r_action_crud = (uint8_t*)ptrs[pi++];
+  o.r_acl_short = (int32_t*)ptrs[pi++];
+  o.r_acl_ent = (int32_t*)ptrs[pi++];
+  o.r_acl_inst = (int32_t*)ptrs[pi++];
+  o.r_acl_hr = (int32_t*)ptrs[pi++];
+  o.r_hr_roles = (int32_t*)ptrs[pi++];
+  o.r_subject_id = (int32_t*)ptrs[pi++];
   o.eligible = (uint8_t*)ptrs[pi++];
   o.batch_entities = (int32_t*)ptrs[pi++];
 
@@ -788,19 +844,82 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
       }
     }
     if (!o.eligible[b]) continue;
-    bool has_acls = false;
-    for (const JValue& res : ctx_resources) {
-      const JValue* meta = res.get("meta");
-      const JValue* acls = jget(meta, "acls");
-      if (acls != nullptr && acls->kind == JValue::Arr && !acls->arr.empty()) {
-        has_acls = true;
+
+    // ---- ACL pair collection (mirrors encode.py; reference:
+    // verifyACL.ts:49-88): walk targeted resource attributes in order; the
+    // first one without ACL metadata is the early all-clear, a malformed
+    // ACL fails, otherwise (entity -> instances) accumulate
+    int32_t acl_short = 0;
+    std::vector<int32_t> acl_ents;
+    std::vector<std::vector<int32_t>> acl_insts;
+    const std::string& s_acl_ind = enc.interner.strings[enc.urn_acl_ind];
+    const std::string& s_acl_inst = enc.interner.strings[enc.urn_acl_inst];
+    for (const Attr& attr : req.resources) {
+      if (attr.id != s_resource_id && attr.id != s_operation) continue;
+      const JValue* ctx_res = find_ctx_resource(ctx_resources, attr.value);
+      const JValue* acl_list = nullptr;
+      if (ctx_res != nullptr) {
+        const JValue* acls = jget(ctx_res->get("meta"), "acls");
+        if (acls != nullptr && acls->kind == JValue::Arr && !acls->arr.empty())
+          acl_list = acls;
+      }
+      if (acl_list == nullptr) {
+        acl_short = 1;  // no ACL metadata: verification passes
+        break;
+      }
+      bool malformed = false;
+      for (const JValue& acl : acl_list->arr) {
+        if (jstr(acl.get("id")) == s_acl_ind) {
+          int32_t ent_id = intern_jstr(enc, acl.get("value"));
+          int pos = -1;
+          for (size_t e = 0; e < acl_ents.size(); ++e)
+            if (acl_ents[e] == ent_id) { pos = (int)e; break; }
+          if (pos < 0) {
+            pos = (int)acl_ents.size();
+            acl_ents.push_back(ent_id);
+            acl_insts.emplace_back();
+          }
+          const JValue* acl_attrs = acl.get("attributes");
+          if (acl_attrs == nullptr || acl_attrs->kind != JValue::Arr ||
+              acl_attrs->arr.empty()) {
+            malformed = true;  // missing ACL instances
+            break;
+          }
+          for (const JValue& attribute : acl_attrs->arr) {
+            if (jstr(attribute.get("id")) == s_acl_inst) {
+              acl_insts[pos].push_back(
+                  intern_jstr(enc, attribute.get("value")));
+            } else {
+              malformed = true;  // missing ACL instance value
+              break;
+            }
+          }
+          if (malformed) break;
+        } else {
+          malformed = true;  // missing ACL indicatory entity
+          break;
+        }
+      }
+      if (malformed) {
+        acl_short = 2;
         break;
       }
     }
-    if (has_acls) {  // verify_acl with ACL metadata is not tensorized
-      o.eligible[b] = 0;
-      continue;
+    if (acl_short == 0) {
+      bool over = (int)acl_ents.size() > NACLE;
+      for (auto& insts : acl_insts) over |= (int)insts.size() > NACLI;
+      if (over) {
+        o.eligible[b] = 0;  // ACL shape beyond caps: fallback
+        continue;
+      }
+      for (size_t e = 0; e < acl_ents.size(); ++e) {
+        o.r_acl_ent[b * NACLE + e] = acl_ents[e];
+        for (size_t i = 0; i < acl_insts[e].size(); ++i)
+          o.r_acl_inst[(b * NACLE + e) * NACLI + i] = acl_insts[e][i];
+      }
     }
+    o.r_acl_short[b] = acl_short;
+    o.r_subject_id[b] = intern_jstr(enc, subject.get("id"));
 
     o.r_ctx_present[b] = req.has_context ? 1 : 0;
     o.r_n_entity_attrs[b] = (int32_t)runs.size();
@@ -939,8 +1058,16 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
         }
       }
     }
+    // verifyACL's own flatten (per-node role override) + its distinct
+    // role keys in first-occurrence order (mirrors encode.py)
+    std::vector<std::array<int32_t, 2>> acl_hr_enc;
+    std::vector<int32_t> hr_roles;
+    if (!hs_missing)
+      flatten_acl_hr(enc, hierarchical_scopes, false, std::string_view(),
+                     acl_hr_enc, hr_roles);
     if ((int)ra3.size() > NRA || (int)ra2.size() > NRA ||
-        (int)hr_enc.size() > NHR || overflow) {
+        (int)hr_enc.size() > NHR || (int)acl_hr_enc.size() > NHR ||
+        (int)hr_roles.size() > NHRR || overflow) {
       o.eligible[b] = 0;
       continue;
     }
@@ -950,6 +1077,11 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
       for (int k = 0; k < 2; ++k) o.r_ra2[(b * NRA + j) * 2 + k] = ra2[j][k];
     for (size_t j = 0; j < hr_enc.size(); ++j)
       for (int k = 0; k < 2; ++k) o.r_hr[(b * NHR + j) * 2 + k] = hr_enc[j][k];
+    for (size_t j = 0; j < acl_hr_enc.size(); ++j)
+      for (int k = 0; k < 2; ++k)
+        o.r_acl_hr[(b * NHR + j) * 2 + k] = acl_hr_enc[j][k];
+    for (size_t j = 0; j < hr_roles.size(); ++j)
+      o.r_hr_roles[b * NHRR + j] = hr_roles[j];
     o.r_n_ra[b] = (int32_t)n_role_assocs;
   }
   return n_batch_entities;

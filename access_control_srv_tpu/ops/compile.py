@@ -333,6 +333,21 @@ def compile_policies(
 
     arrays = dict(a)
     arrays.update(table.to_arrays())
+    # interned URN ids the ACL kernel stage compares against (reference:
+    # verifyACL.ts:37-44, 138-150): [role attr id, user entity, actionID
+    # attr id, create, read, modify, delete]
+    arrays["acl_consts"] = np.array(
+        [
+            interner.intern(urns.get("role")),
+            interner.intern(urns.get("user")),
+            interner.intern(urns.get("actionID")),
+            interner.intern(urns.get("create")),
+            interner.intern(urns.get("read")),
+            interner.intern(urns.get("modify")),
+            interner.intern(urns.get("delete")),
+        ],
+        np.int32,
+    )
 
     compiled = CompiledPolicies(
         interner=interner,

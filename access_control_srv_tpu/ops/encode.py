@@ -11,8 +11,8 @@ Ineligibility triggers:
 
 - a subject token (identity resolution / HR-scope rendezvous is a host
   protocol, reference: src/core/accessController.ts:110-123);
-- context resources carrying ACLs (verifyACL not yet tensorized);
-- attribute counts beyond the padding caps;
+- attribute counts beyond the padding caps (including ACL scoping-entity/
+  instance counts and distinct HR-tree role counts);
 - malformed property URNs, properties preceding their entity, or
   entity-name substring relevance diverging from id equality (the
   reference matches properties to entities by substring, reference:
@@ -48,6 +48,9 @@ NOWN = 4    # owner pairs per instance
 NRA = 8     # role-association triples / pairs
 NHR = 32    # flattened HR-scope pairs
 NROLE = 4   # subject roles
+NACLE = 4   # distinct ACL scoping entities per request
+NACLI = 8   # ACL instances per scoping entity
+NHRR = 8    # distinct HR-tree roles (verifyACL flatten) per request
 
 
 @dataclass
@@ -101,6 +104,23 @@ def _flatten_hr(scopes, out: list[tuple[Optional[str], str]]):
             stack.extend(get_field(node, "children") or [])
 
 
+def _flatten_acl_hr(nodes, out: list, role=None):
+    """verifyACL's OWN tree flatten (reference: verifyACL.ts:119-129
+    getRoleOrgMapping): pre-order, a node's ``role`` field overrides the
+    inherited one for itself AND its subtree — unlike the HR matcher's
+    flatten above, which keys every node by the top-level role only."""
+    for node in nodes or []:
+        key = get_field(node, "role")
+        if key is None:
+            key = role
+        node_id = get_field(node, "id")
+        if node_id:
+            out.append((key, node_id))
+        children = get_field(node, "children") or []
+        if children:
+            _flatten_acl_hr(children, out, key)
+
+
 def alloc_row_arrays(B: int) -> dict[str, np.ndarray]:
     """The per-request kernel row arrays; shared by the Python encoder and
     the native (C++) wire encoder, which fills the same buffers in place
@@ -143,6 +163,20 @@ def alloc_row_arrays(B: int) -> dict[str, np.ndarray]:
         # associations fail (:96-100) and only CRUD actions pass (:148-248)
         "r_has_idop": np.zeros((B,), bool),
         "r_action_crud": np.zeros((B,), bool),
+        # verify_acl ACL-pair inputs (reference: verifyACL.ts:37-88,
+        # 119-136, 148-248). acl_short: 0 = pairs mode, 1 = early all-clear
+        # (a targeted resource without ACL metadata, :56-59), 2 = malformed
+        # ACL fail (:72-82). The native (C++) wire encoder does not fill
+        # these: it marks ACL-carrying rows ineligible, leaving the
+        # defaults, which read as "no pairs".
+        "r_acl_short": np.zeros((B,), np.int32),
+        "r_acl_ent": np.full((B, NACLE), ABSENT, np.int32),
+        "r_acl_inst": np.full((B, NACLE, NACLI), ABSENT, np.int32),
+        # verifyACL's role->org flatten (per-node role override) and its
+        # distinct role keys in first-occurrence order (:119-136)
+        "r_acl_hr": np.full((B, NHR, 2), ABSENT, np.int32),
+        "r_hr_roles": np.full((B, NHRR), ABSENT, np.int32),
+        "r_subject_id": np.full((B,), ABSENT, np.int32),
     }
 
 
@@ -166,6 +200,8 @@ def encode_requests(
     owner_ent_urn = urns.get("ownerEntity")
     owner_inst_urn = urns.get("ownerInstance")
     action_id_urn = urns.get("actionID")
+    acl_ind_urn = urns.get("aclIndicatoryEntity")
+    acl_inst_urn = urns.get("aclInstance")
     crud_actions = {
         urns.get("create"), urns.get("read"),
         urns.get("modify"), urns.get("delete"),
@@ -286,16 +322,72 @@ def encode_requests(
             continue
 
         ctx_resources = get_field(context, "resources") or [] if context else []
-        # ACLs present anywhere -> oracle fallback (kernel v1)
-        has_acls = False
-        for res in ctx_resources:
-            meta = get_field(res, "meta")
-            if meta and (get_field(meta, "acls") or []):
-                has_acls = True
+
+        # ---- ACL pair collection (reference: verifyACL.ts:49-88): walk the
+        # targeted resource attributes in order; the first one without ACL
+        # metadata is the early all-clear, a malformed ACL fails, otherwise
+        # (entity -> instances) accumulate across resources
+        acl_short = 0
+        acl_ents: list[int] = []
+        acl_insts: list[list[int]] = []
+        acl_ent_pos: dict[int, int] = {}
+        for attr in target.resources or []:
+            if attr.id != resource_id_urn and attr.id != operation_urn:
+                continue
+            ctx_res = find_ctx_resource(ctx_resources, attr.value)
+            acl_list = None
+            if ctx_res is not None:
+                meta = get_field(ctx_res, "meta")
+                acls = get_field(meta, "acls") if meta else None
+                if acls and len(acls) > 0:
+                    acl_list = acls
+            if not acl_list:
+                acl_short = 1  # no ACL metadata: verification passes
                 break
-        if has_acls:
-            mark(b)
+            malformed = False
+            for acl in acl_list:
+                if get_field(acl, "id") == acl_ind_urn:
+                    ent_id = it(get_field(acl, "value"))
+                    pos = acl_ent_pos.get(ent_id)
+                    if pos is None:
+                        pos = len(acl_ents)
+                        acl_ent_pos[ent_id] = pos
+                        acl_ents.append(ent_id)
+                        acl_insts.append([])
+                    acl_attrs = get_field(acl, "attributes")
+                    if not acl_attrs:
+                        malformed = True  # missing ACL instances
+                        break
+                    for attribute in acl_attrs:
+                        if get_field(attribute, "id") == acl_inst_urn:
+                            acl_insts[pos].append(
+                                it(get_field(attribute, "value"))
+                            )
+                        else:
+                            malformed = True  # missing ACL instance value
+                            break
+                    if malformed:
+                        break
+                else:
+                    malformed = True  # missing ACL indicatory entity
+                    break
+            if malformed:
+                acl_short = 2
+                break
+        if acl_short == 0 and (
+            len(acl_ents) > NACLE
+            or any(len(insts) > NACLI for insts in acl_insts)
+        ):
+            mark(b)  # ACL shape beyond caps: oracle fallback
             continue
+        a["r_acl_short"][b] = acl_short
+        if acl_short == 0:
+            for j, ent_id in enumerate(acl_ents):
+                a["r_acl_ent"][b, j] = ent_id
+                for k, inst_id in enumerate(acl_insts[j]):
+                    a["r_acl_inst"][b, j, k] = inst_id
+        sid = get_field(subject, "id")
+        a["r_subject_id"][b] = it(sid) if isinstance(sid, str) else ABSENT
 
         a["r_ctx_present"][b] = bool(context)
         a["r_n_entity_attrs"][b] = len(runs)
@@ -383,7 +475,25 @@ def encode_requests(
             entry = (it(role) if role is not None else ABSENT, it(org))
             if entry not in hr_enc:
                 hr_enc.append(entry)
-        if len(ra3) > NRA or len(ra2) > NRA or len(hr_enc) > NHR or overflow:
+        # verifyACL's own flatten: per-node role override, pre-order; the
+        # distinct role keys (None excluded — it can never be a rule's
+        # scoped role) keep first-occurrence order because the create-path
+        # scan is order-sensitive (reference: verifyACL.ts:160-171)
+        acl_hr_pairs: list = []
+        _flatten_acl_hr(hierarchical_scopes, acl_hr_pairs)
+        acl_hr_enc: list[tuple[int, int]] = []
+        hr_roles: list[int] = []
+        for role, org in acl_hr_pairs:
+            rid = it(role) if role is not None else ABSENT
+            entry = (rid, it(org))
+            if entry not in acl_hr_enc:
+                acl_hr_enc.append(entry)
+            if role is not None and rid not in hr_roles:
+                hr_roles.append(rid)
+        if (
+            len(ra3) > NRA or len(ra2) > NRA or len(hr_enc) > NHR
+            or len(acl_hr_enc) > NHR or len(hr_roles) > NHRR or overflow
+        ):
             mark(b)
             continue
         for j, t3 in enumerate(ra3):
@@ -392,6 +502,10 @@ def encode_requests(
             a["r_ra2"][b, j] = t2
         for j, t2 in enumerate(hr_enc):
             a["r_hr"][b, j] = t2
+        for j, t2 in enumerate(acl_hr_enc):
+            a["r_acl_hr"][b, j] = t2
+        for j, rid in enumerate(hr_roles):
+            a["r_hr_roles"][b, j] = rid
         a["r_n_ra"][b] = len(role_assocs)
 
     # ---- regex matrices [W, E]

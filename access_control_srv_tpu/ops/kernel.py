@@ -85,6 +85,184 @@ def _member(needle, haystack):
     return jnp.any((haystack == needle) & (haystack >= 0))
 
 
+def _action_kind(c: dict, r: dict):
+    """0 = other, 1 = create, 2 = read/modify/delete, judged on the FIRST
+    action attribute only (reference: verifyACL.ts:138-144, 177-181)."""
+    k = c["acl_consts"]
+    id0, val0 = r["r_act_ids"][0], r["r_act_vals"][0]
+    is_action = id0 == k[2]
+    create = is_action & (val0 == k[3])
+    rmd = is_action & ((val0 == k[4]) | (val0 == k[5]) | (val0 == k[6]))
+    return jnp.where(create, 1, jnp.where(rmd, 2, 0))
+
+
+def _acl_pass(c: dict, r: dict, with_acl: bool):
+    """Stage B2: tensorized verifyACL per target row -> [T] bool
+    (reference: verifyACL.ts:11-251).
+
+    The request-side encoder pre-reduces the resource walk to
+    ``r_acl_short`` (0 pairs / 1 early all-clear / 2 malformed-fail) and
+    (scoping entity, instances) pair arrays; the rule-dependent parts —
+    skipACL, the rule's scoped roles, the create-path role scan and the
+    read/modify/delete membership — run here. The create path's sequential
+    break/continue semantics (validated-instance accumulation across roles,
+    the carried valid flag across scoping entities, :146-175) are
+    reproduced exactly with a lax.scan over the padded (role, instance)
+    grid; read/modify/delete (:177-200) is a pure masked reduction.
+
+    ``with_acl=False`` compiles only the no-pair fast path (exact whenever
+    the batch carries no ACL pairs, which the kernel entry checks)."""
+    k = c["acl_consts"]
+    T = c["t_role"].shape[0]
+    skip = c["t_skip_acl"]  # [T]
+    short = r["r_acl_short"]
+    kind = _action_kind(c, r)
+
+    if not with_acl:
+        # no-pair fast path: early all-clear passes; otherwise role
+        # associations must exist and the first action must be CRUD
+        # (create/rmd with an empty entity dict both return True,
+        # :147-148, 184-185; any other action falls through to False,
+        # :250). short==2 (malformed) correctly yields False here too.
+        return skip | (short == 1) | (
+            (short == 0) & (r["r_n_ra"] > 0) & (kind > 0)
+        )
+
+    ents = r["r_acl_ent"]        # [NACLE]
+    insts = r["r_acl_inst"]      # [NACLE, NACLI]
+    ev = ents >= 0
+    iv = insts >= 0
+    NACLE, NACLI = insts.shape
+    has_ents = ev.any()
+
+    # rule's scoped roles: subject attr pairs whose id is the role urn
+    scoped_mask = (c["t_sub_ids"] == k[0]) & (c["t_sub_vals"] >= 0)  # [T,KS]
+    user_e = ev & (ents == k[1])  # [NACLE]
+
+    # subject_scoped existence per entity: any role association (role,
+    # scoping-entity) pair with a rule-scoped role (:94-112, 156-157)
+    ra2 = r["r_ra2"]
+    ra2v = ra2[:, 1] >= 0
+    ra2_scoped = (
+        (ra2[None, None, :, 0] == c["t_sub_vals"][:, :, None])
+        & scoped_mask[:, :, None]
+    ).any(axis=1)  # [T, NRA]
+    subj_exists = (
+        ra2_scoped[:, None, :]
+        & (ents[None, :, None] == ra2[None, None, :, 1])
+        & ra2v[None, None, :]
+    ).any(axis=2)  # [T, NACLE]
+
+    # ---- read/modify/delete: >=1 subject scope instance (or the subject
+    # id itself for user-entity ACLs) appears in the ACL (:177-200)
+    ra3 = r["r_ra3"]
+    ra3v = ra3[:, 1] >= 0
+    ra3_scoped = (
+        (ra3[None, None, :, 0] == c["t_sub_vals"][:, :, None])
+        & scoped_mask[:, :, None]
+    ).any(axis=1)  # [T, NRA]
+    inst_has = (
+        (insts[:, :, None] == ra3[None, None, :, 2]) & iv[:, :, None]
+    ).any(axis=1)  # [NACLE, NRA] instance value present in entity's ACL
+    rmd_sub = (
+        ra3_scoped[:, None, :]
+        & (ents[None, :, None] == ra3[None, None, :, 1])
+        & inst_has[None, :, :]
+        & ra3v[None, None, :]
+    ).any(axis=2)  # [T, NACLE]
+    subj_in = ((insts == r["r_subject_id"]) & iv).any(axis=1)  # [NACLE]
+    rmd_ok = (
+        ev[None, :] & ((user_e & subj_in)[None, :] | rmd_sub)
+    ).any(axis=1)  # [T]
+    rmd_res = ~has_ents | rmd_ok
+
+    # ---- create: every target ACL instance inside the subject's HR org
+    # scopes for a shared role (:141-175), exact sequential semantics
+    hr_roles = r["r_hr_roles"]  # [NHRR]
+    NHRR = hr_roles.shape[0]
+    hrr_v = hr_roles >= 0
+    role_scoped = (
+        (hr_roles[None, None, :] == c["t_sub_vals"][:, :, None])
+        & scoped_mask[:, :, None]
+    ).any(axis=1) & hrr_v[None, :]  # [T, NHRR]
+    ahr = r["r_acl_hr"]  # [NHR, 2] verifyACL flatten (role, org)
+    ahrv = ahr[:, 1] >= 0
+    # eligible_org_scopes membership per (entity, instance, hr role)
+    elig = (
+        (insts[:, :, None, None] == ahr[None, None, None, :, 1])
+        & (hr_roles[None, None, :, None] == ahr[None, None, None, :, 0])
+        & ahrv[None, None, None, :]
+    ).any(axis=3) & iv[:, :, None]  # [NACLE, NACLI, NHRR]
+    same_val = (
+        (insts[:, :, None] == insts[:, None, :]) & iv[:, None, :]
+    )  # [NACLE, NACLI(i), NACLI(j)]
+
+    # scan over the flattened (role, instance) grid; carry the validated
+    # instance set (persists across roles within an entity), the per-role
+    # broken flag (inner-loop break, :169-171) and the last set/fail event
+    steps = NHRR * NACLI
+    r_of_s = np.arange(steps) // NACLI
+    i_of_s = np.arange(steps) % NACLI
+    xs = (
+        jnp.asarray(np.eye(NACLI, dtype=bool)[i_of_s]),
+        # [steps, NACLI] one-hot of the instance position
+        elig[:, i_of_s, r_of_s].T,
+        # [steps, NACLE] eligibility of (entity, current instance, role)
+        jnp.moveaxis(same_val[:, i_of_s, :], 1, 0),
+        # [steps, NACLE, NACLI] value-equality row of the current instance
+        iv[:, i_of_s].T,                    # [steps, NACLE] instance valid
+        jnp.asarray(i_of_s == 0),           # [steps] role-start reset
+        jnp.asarray(r_of_s, np.int32),      # [steps] role index
+    )
+
+    def step(carry, x):
+        validated, broken, last_ev = carry
+        onehot, elig_cur, samev_cur, iv_cur, at_start, role_idx = x
+        rsc = role_scoped[:, role_idx]  # [T]
+        broken = broken & ~at_start
+        active = (
+            rsc[:, None] & iv_cur[None, :] & ~broken
+        )  # [T, NACLE]
+        in_validated = (validated & samev_cur[None, :, :]).any(axis=2)
+        hit = active & elig_cur[None, :]
+        fail = active & ~elig_cur[None, :] & ~in_validated
+        validated = validated | (hit[:, :, None] & onehot[None, None, :])
+        broken = broken | fail
+        last_ev = jnp.where(hit, 1, jnp.where(fail, 2, last_ev))
+        return (validated, broken, last_ev), None
+
+    init = (
+        jnp.zeros((T, NACLE, NACLI), bool),
+        jnp.zeros((T, NACLE), bool),
+        jnp.zeros((T, NACLE), jnp.int32),
+    )
+    (validated, broken, last_ev), _ = jax.lax.scan(step, init, xs)
+    ev_any = last_ev > 0           # [T, NACLE]
+    ev_true = last_ev == 1
+
+    # compose entities in order with the carried valid flag (:146-175);
+    # user-entity ACLs set valid and skip the per-entity check (:150-153)
+    v = jnp.zeros((T,), bool)
+    alive = jnp.ones((T,), bool)
+    for e in range(NACLE):
+        is_real = ev[e]
+        is_user = user_e[e]
+        v_out = jnp.where(ev_any[:, e], ev_true[:, e], v)
+        fail_e = ~is_user & (~subj_exists[:, e] | ~v_out)
+        v = jnp.where(is_real, jnp.where(is_user, True, v_out), v)
+        alive = alive & (~is_real | ~fail_e)
+    create_res = ~has_ents | alive
+
+    # create_res/rmd_res already fold the empty-entity-dict -> True case
+    # (:147-148, 184-185), so this single pair_ok covers short==0 whether
+    # or not the request carries ACL pairs
+    pair_ok = (
+        (r["r_n_ra"] > 0)
+        & jnp.where(kind == 1, create_res, jnp.where(kind == 2, rmd_res, False))
+    )
+    return skip | (short == 1) | ((short == 0) & pair_ok)
+
+
 def _match_targets(c: dict, r: dict):
     """Stages A (target matching) + B (HR scopes) for one request: returns
     per-target-row match vectors the rule/policy stages gather from.
@@ -309,7 +487,7 @@ def _match_targets(c: dict, r: dict):
     }
 
 
-def _rule_predicates(c: dict, r: dict, m: dict):
+def _rule_predicates(c: dict, r: dict, m: dict, with_acl: bool = True):
     """Stage C: per-rule reachability, ACL gate and condition wiring;
     shared by the single-device and rule-sharded kernels (the latter passes
     a KR-chunked ``c`` with a compacted target subtable)."""
@@ -328,15 +506,9 @@ def _rule_predicates(c: dict, r: dict, m: dict):
     hr_rule = ~c["rule_has_target"] | gather_t(hr_pass, rt)
     reached = c["rule_valid"] & tm_rule & hr_rule
 
-    # verify_acl no-ACL semantics (eligible requests carry no ACL
-    # metadata): skipACL passes; any resourceID/operation attribute hits
-    # the early all-clear; otherwise role associations must exist and the
-    # first action must be a CRUD action (reference: verifyACL.ts:21-24,
-    # 56-59, 96-100, 148-250)
-    acl_ok_t = gather_t(c["t_skip_acl"], rt) | r["r_has_idop"] | (
-        (r["r_n_ra"] > 0) & r["r_action_crud"]
-    )
-    acl_rule = ~c["rule_has_target"] | acl_ok_t
+    # verifyACL per target row (stage B2): full tensorized semantics when
+    # the batch carries ACL pairs, the cheap no-pair formula otherwise
+    acl_rule = ~c["rule_has_target"] | gather_t(_acl_pass(c, r, with_acl), rt)
 
     has_cond = c["rule_cond"] >= 0
     cond_idx = jnp.clip(c["rule_cond"], 0, None)
@@ -453,16 +625,20 @@ def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     return decision, cacheable
 
 
-def _evaluate_one(c: dict, r: dict):
+def _evaluate_one(c: dict, r: dict, with_acl: bool = True):
     """Decision for a single encoded request; vmapped over the batch.
 
     ``c``: compiled policy arrays (replicated across devices).
     ``r``: per-request encoded arrays.
+    ``with_acl``: compile the full verifyACL stage (exact when ACL pairs
+    are present; batches without pairs may use the cheaper False variant).
     Returns (decision, cacheable, status_code) int32 scalars where
     decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
     """
     m = _match_targets(c, r)
-    reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(c, r, m)
+    reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(
+        c, r, m, with_acl
+    )
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
 
     # -------------------------------------------------- E: combine rule effects
@@ -550,26 +726,34 @@ class DecisionKernel:
         self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
         self._bake_constants = bake_policy_constants(compiled)
 
-        def run(c, batch_arrays, rgx_set, pfx_neq, cond_true, cond_abort, cond_code):
-            # vmap over the leading batch axis of request arrays; regex
-            # matrices and compiled arrays are broadcast
-            in_axes = ({k: 0 for k in batch_arrays}, None, None, 0, 0, 0)
+        def make_run(with_acl: bool):
+            def run(c, batch_arrays, rgx_set, pfx_neq,
+                    cond_true, cond_abort, cond_code):
+                # vmap over the leading batch axis of request arrays; regex
+                # matrices and compiled arrays are broadcast
+                in_axes = ({k: 0 for k in batch_arrays}, None, None, 0, 0, 0)
 
-            def one(ra, rs, pn, ct, ca, cc):
-                rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
-                      "cond_true": ct, "cond_abort": ca, "cond_code": cc}
-                return _evaluate_one(c, rr)
+                def one(ra, rs, pn, ct, ca, cc):
+                    rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
+                          "cond_true": ct, "cond_abort": ca, "cond_code": cc}
+                    return _evaluate_one(c, rr, with_acl)
 
-            return jax.vmap(one, in_axes=in_axes)(
-                batch_arrays, rgx_set, pfx_neq,
-                cond_true.T, cond_abort.T, cond_code.T,
-            )
+                return jax.vmap(one, in_axes=in_axes)(
+                    batch_arrays, rgx_set, pfx_neq,
+                    cond_true.T, cond_abort.T, cond_code.T,
+                )
 
-        if self._bake_constants:
-            self._run = jax.jit(partial(run, self._c))
-        else:
-            self._jit = jax.jit(run)
-            self._run = lambda *args: self._jit(self._c, *args)
+            if self._bake_constants:
+                return jax.jit(partial(run, self._c))
+            jitted = jax.jit(run)
+            return lambda *args: jitted(self._c, *args)
+
+        # two compiled variants: batches without ACL pairs (the common
+        # serving mix) skip the create-path scan entirely; the entry
+        # dispatches on the batch's actual content
+        self._run_noacl = make_run(False)
+        self._run_acl = make_run(True)
+        self._run = self._run_noacl
 
     def evaluate(self, batch: RequestBatch):
         """Returns (decision, cacheable, status) numpy arrays [B].
@@ -593,7 +777,15 @@ class DecisionKernel:
         # regex matrices keep a stable compiled shape
         e_bucket = pow2_bucket(batch.rgx_set.shape[1])
 
-        out = self._run(
+        # dispatch on ACL content: only batches actually carrying ACL
+        # pairs pay for the tensorized verifyACL create-scan (the no-pair
+        # variant is exact for everything else, incl. short==1/2 rows)
+        run = (
+            self._run_acl
+            if bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any())
+            else self._run_noacl
+        )
+        out = run(
             {k: jnp.asarray(pad_lead(v)) for k, v in batch.arrays.items()},
             jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
             jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),

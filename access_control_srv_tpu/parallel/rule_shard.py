@@ -119,6 +119,7 @@ def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
         "set_valid", "set_ca", "set_has_target", "pol_valid", "pol_ca",
         "pol_effect", "pol_cacheable", "pol_has_target", "pol_has_subjects",
         "pol_n_rules", "pol_eff_ctx", "pol_has_props", "pol_ent_vals",
+        "acl_consts",
     ]
     stacked: dict[str, np.ndarray] = {}
     for name in list(shard_arrays[0]):

@@ -1,0 +1,407 @@
+"""Tensorized verifyACL (kernel stage B2) vs the scalar oracle.
+
+The ACL check is the quirkiest part of the reference
+(reference: src/core/verifyACL.ts:11-251): early all-clear on the first
+targeted resource without ACL metadata, malformed-ACL failure, the
+create path's sequential role scan with a validated-instance list and a
+valid flag CARRIED ACROSS scoping entities, the user.User exemption, and
+a role->org flatten with per-node role override that differs from the HR
+matcher's flatten.  Every case here runs the same request through the
+oracle and the kernel and asserts bit-identical decisions.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from access_control_srv_tpu.models import Decision
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    compile_policies,
+    encode_requests,
+)
+
+from .test_kernel_differential import DEC_CODE
+from .utils import URNS, build_request, make_engine
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+USER = "urn:restorecommerce:acs:model:user.User"
+BUCKET = "urn:restorecommerce:acs:model:bucket.Bucket"
+
+
+def rig(fixture="acl_policies.yml"):
+    engine = make_engine(fixture)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    return engine, compiled, DecisionKernel(compiled)
+
+
+def assert_differential(engine, compiled, kernel, requests, min_eligible=None):
+    batch = encode_requests(requests, compiled)
+    n_eligible = int(batch.eligible.sum())
+    if min_eligible is not None:
+        assert n_eligible >= min_eligible, (n_eligible, len(requests))
+    decision, cacheable, status = kernel.evaluate(batch)
+    checked = 0
+    for b, request in enumerate(requests):
+        if not batch.eligible[b]:
+            continue
+        expected = engine.is_allowed(request)
+        assert decision[b] == DEC_CODE[expected.decision], (
+            b, decision[b], expected.decision
+        )
+        assert status[b] == expected.operation_status.code, (
+            b, status[b], expected.operation_status.code
+        )
+        checked += 1
+    return checked
+
+
+def test_acl_requests_are_kernel_eligible():
+    """The core deliverable: meta.acls no longer forces oracle fallback."""
+    engine, compiled, kernel = rig()
+    request = build_request(
+        subject_id="Alice", subject_role="Admin",
+        role_scoping_entity=ORG, role_scoping_instance="Org1",
+        resource_type=BUCKET, resource_id="test",
+        action_type=URNS["create"],
+        owner_indicatory_entity=ORG, owner_instance="Org1",
+        acl_indicatory_entity=ORG, acl_instances=["Org1"],
+    )
+    batch = encode_requests([request], compiled)
+    assert batch.eligible[0]
+    assert_differential(engine, compiled, kernel, [request], min_eligible=1)
+
+
+@pytest.mark.parametrize("action", ["create", "read", "modify", "delete",
+                                    "execute"])
+def test_actions_with_acl_meta(action):
+    """All action kinds against in-scope and out-of-scope ACL instances;
+    non-CRUD actions with ACL metadata fail verifyACL (:250)."""
+    engine, compiled, kernel = rig()
+    requests = []
+    for instances in (["Org1"], ["Org3"], ["otherOrg"], ["Org1", "otherOrg"],
+                      ["Alice"], ["SuperOrg1", "Org2"]):
+        requests.append(build_request(
+            subject_id="Alice", subject_role="Admin",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=BUCKET, resource_id="test",
+            action_type=URNS[action],
+            owner_indicatory_entity=ORG, owner_instance="Org1",
+            acl_indicatory_entity=ORG, acl_instances=instances,
+        ))
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+
+
+def test_user_entity_acls():
+    """user.User scoping entities: create-path exemption (:150-153) and
+    the rmd subject-id membership check (:190-193)."""
+    engine, compiled, kernel = rig()
+    requests = []
+    for action in ("create", "read", "modify", "delete"):
+        for instances in (["Alice"], ["Bob"], ["Alice", "Bob"]):
+            requests.append(build_request(
+                subject_id="Alice", subject_role="Admin",
+                role_scoping_entity=ORG, role_scoping_instance="Org1",
+                resource_type=BUCKET, resource_id="test",
+                action_type=URNS[action],
+                owner_indicatory_entity=ORG, owner_instance="Org1",
+                acl_indicatory_entity=USER, acl_instances=instances,
+            ))
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+
+
+def test_mixed_org_and_user_acl_entities():
+    """Two scoping entities on one resource: the valid flag carries across
+    entities in the create path (:146-175)."""
+    engine, compiled, kernel = rig()
+    requests = []
+    for action in ("create", "read"):
+        for orgs, users in ((["Org1"], ["Alice"]), (["otherOrg"], ["Alice"]),
+                            (["Org2"], ["Bob"]), (["otherOrg"], ["Bob"])):
+            requests.append(build_request(
+                subject_id="Alice", subject_role="Admin",
+                role_scoping_entity=ORG, role_scoping_instance="Org1",
+                resource_type=BUCKET, resource_id="test",
+                action_type=URNS[action],
+                owner_indicatory_entity=ORG, owner_instance="Org1",
+                multiple_acl_indicatory_entity=[ORG, USER],
+                org_instances=orgs, subject_instances=users,
+            ))
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+
+
+def _with_acls(request, acls):
+    """Overwrite the context resources' acls list in place."""
+    for res in request.context["resources"]:
+        res["meta"]["acls"] = acls
+    return request
+
+
+def test_malformed_acls_fail_closed():
+    """Wrong attribute ids / missing instances make verifyACL return False
+    (:72-82); the kernel must agree through the short=2 encoding."""
+    engine, compiled, kernel = rig()
+    base = dict(
+        subject_id="Alice", subject_role="Admin",
+        role_scoping_entity=ORG, role_scoping_instance="Org1",
+        resource_type=BUCKET, resource_id="test",
+        action_type=URNS["create"],
+        owner_indicatory_entity=ORG, owner_instance="Org1",
+    )
+    malformed = [
+        # wrong top-level id
+        [{"id": "urn:wrong", "value": ORG,
+          "attributes": [{"id": URNS["aclInstance"], "value": "Org1"}]}],
+        # empty attributes
+        [{"id": URNS["aclIndicatoryEntity"], "value": ORG, "attributes": []}],
+        # wrong nested id
+        [{"id": URNS["aclIndicatoryEntity"], "value": ORG,
+          "attributes": [{"id": "urn:wrong", "value": "Org1"}]}],
+    ]
+    requests = [
+        _with_acls(build_request(**base), acls) for acls in malformed
+    ]
+    checked = assert_differential(engine, compiled, kernel, requests,
+                                  min_eligible=len(requests))
+    assert checked == len(requests)
+    # malformed ACLs make the PERMIT rule unmatched -> not PERMIT
+    for request in requests:
+        assert engine.is_allowed(request).decision != Decision.PERMIT
+
+
+def test_first_resource_without_acl_short_circuits():
+    """The FIRST targeted resource without ACL metadata passes the whole
+    check (:56-59), even if a later resource carries a malformed ACL."""
+    engine, compiled, kernel = rig()
+    good_acl = [{"id": URNS["aclIndicatoryEntity"], "value": ORG,
+                 "attributes": [{"id": URNS["aclInstance"], "value": "Org1"}]}]
+    bad_acl = [{"id": "urn:wrong", "value": ORG, "attributes": []}]
+
+    def two_resource_request(first_acls, second_acls):
+        request = build_request(
+            subject_id="Alice", subject_role="Admin",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=[BUCKET, BUCKET], resource_id=["r1", "r2"],
+            action_type=URNS["read"],
+            owner_indicatory_entity=ORG, owner_instance=["Org1", "Org1"],
+        )
+        ctx = request.context["resources"]
+        assert ctx[0]["id"] == "r1" and ctx[1]["id"] == "r2"
+        ctx[0]["meta"]["acls"] = first_acls
+        ctx[1]["meta"]["acls"] = second_acls
+        return request
+
+    requests = [
+        two_resource_request([], bad_acl),        # no-acl first -> pass
+        two_resource_request(bad_acl, []),        # malformed first -> fail
+        two_resource_request(good_acl, bad_acl),  # good then malformed
+        two_resource_request(bad_acl, good_acl),
+    ]
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+    assert engine.is_allowed(requests[0]).decision == Decision.PERMIT
+    assert engine.is_allowed(requests[1]).decision != Decision.PERMIT
+
+
+def test_per_node_role_override_tree():
+    """verifyACL's flatten honors per-node role overrides (:119-129) —
+    unlike the HR matcher's top-level-role flatten; the create path must
+    see orgs under the overriding role key."""
+    engine, compiled, kernel = rig()
+    tree = [{
+        "id": "SuperOrg1", "role": "OtherRole",
+        "children": [
+            # this subtree's nodes belong to Admin in verifyACL's map
+            {"id": "Org1", "role": "Admin",
+             "children": [{"id": "Org2"}]},
+            {"id": "OrgX"},  # stays under OtherRole
+        ],
+    }]
+    requests = []
+    for instances in (["Org2"], ["OrgX"], ["SuperOrg1"], ["Org1", "Org2"]):
+        requests.append(build_request(
+            subject_id="Alice", subject_role="Admin",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=BUCKET, resource_id="test",
+            action_type=URNS["create"],
+            owner_indicatory_entity=ORG, owner_instance="Org1",
+            acl_indicatory_entity=ORG, acl_instances=instances,
+            hierarchical_scopes=tree,
+        ))
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+    # Org2 inherits Admin via the Org1 override -> in eligible org scopes
+    assert engine.is_allowed(requests[0]).decision == Decision.PERMIT
+    # OrgX belongs to OtherRole (not a rule role) -> create fails
+    assert engine.is_allowed(requests[1]).decision != Decision.PERMIT
+
+
+def test_duplicate_and_repeated_instances():
+    """Duplicate ACL instances exercise the validated-instance list
+    semantics of the create scan (:164-171)."""
+    engine, compiled, kernel = rig()
+    requests = []
+    for instances in (["Org1", "Org1"], ["Org1", "otherOrg", "Org1"],
+                      ["otherOrg", "otherOrg"]):
+        requests.append(build_request(
+            subject_id="Alice", subject_role="Admin",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=BUCKET, resource_id="test",
+            action_type=URNS["create"],
+            owner_indicatory_entity=ORG, owner_instance="Org1",
+            acl_indicatory_entity=ORG, acl_instances=instances,
+        ))
+    assert_differential(engine, compiled, kernel, requests,
+                        min_eligible=len(requests))
+
+
+def test_skip_acl_rule_passes_malformed_acls():
+    """A rule subject carrying skipACL passes immediately (:21-24), even
+    against a malformed ACL that would otherwise fail."""
+    from access_control_srv_tpu.core import AccessController
+    from access_control_srv_tpu.core.loader import load_policy_sets
+
+    PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+    doc = {"policy_sets": [{
+        "id": "ps", "combining_algorithm": PO,
+        "policies": [{
+            "id": "p", "combining_algorithm": PO,
+            "rules": [{
+                "id": "r_skip",
+                "target": {
+                    "subjects": [
+                        {"id": URNS["role"], "value": "Admin"},
+                        {"id": URNS["skipACL"], "value": "true"},
+                    ],
+                    "resources": [{"id": URNS["entity"], "value": BUCKET}],
+                    "actions": [{"id": URNS["actionID"],
+                                 "value": URNS["create"]}],
+                },
+                "effect": "PERMIT",
+            }],
+        }],
+    }]}
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    kernel = DecisionKernel(compiled)
+
+    request = _with_acls(
+        build_request(
+            subject_id="Alice", subject_role="Admin",
+            role_scoping_entity=ORG, role_scoping_instance="Org1",
+            resource_type=BUCKET, resource_id="test",
+            action_type=URNS["create"],
+        ),
+        [{"id": "urn:wrong", "value": ORG, "attributes": []}],
+    )
+    assert engine.is_allowed(request).decision == Decision.PERMIT
+    assert_differential(engine, compiled, kernel, [request], min_eligible=1)
+
+
+def test_randomized_acl_differential():
+    """Randomized ACL-heavy mix: entities, instance sets, per-node role
+    override trees, all action kinds; kernel == oracle on every eligible
+    row (and the mix must stay mostly eligible)."""
+    engine, compiled, kernel = rig()
+    rng = random.Random(17)
+    OWNERS = ["SuperOrg1", "Org1", "Org2", "Org3", "otherOrg", "OrgX"]
+    SUBJECTS = ["Alice", "Bob"]
+
+    def random_tree():
+        if rng.random() < 0.5:
+            return None  # build_request default chain
+        def node(d, idx):
+            out = {"id": rng.choice(OWNERS) + (f"-{idx}" if rng.random() < 0.3
+                                               else "")}
+            if rng.random() < 0.4:
+                out["role"] = rng.choice(["Admin", "SimpleUser", "Other"])
+            if d < 3 and rng.random() < 0.6:
+                out["children"] = [node(d + 1, i) for i in
+                                   range(rng.randint(1, 2))]
+            return out
+        top = node(0, 0)
+        top.setdefault("role", rng.choice(["Admin", "SimpleUser"]))
+        return [top]
+
+    requests = []
+    for i in range(400):
+        kw = dict(
+            subject_id=rng.choice(SUBJECTS),
+            subject_role=rng.choice(["Admin", "SimpleUser"]),
+            role_scoping_entity=ORG,
+            role_scoping_instance=rng.choice(OWNERS),
+            resource_type=BUCKET, resource_id=f"res-{i % 7}",
+            action_type=URNS[rng.choice(
+                ["create", "read", "modify", "delete", "execute"])],
+            owner_indicatory_entity=ORG,
+            owner_instance=rng.choice(OWNERS),
+            hierarchical_scopes=random_tree(),
+        )
+        mode = rng.random()
+        if mode < 0.5:
+            kw.update(
+                acl_indicatory_entity=rng.choice([ORG, USER]),
+                acl_instances=rng.sample(OWNERS + SUBJECTS,
+                                         rng.randint(1, 4)),
+            )
+        elif mode < 0.7:
+            kw.update(
+                multiple_acl_indicatory_entity=[ORG, USER],
+                org_instances=rng.sample(OWNERS, rng.randint(1, 2)),
+                subject_instances=rng.sample(SUBJECTS, rng.randint(1, 2)),
+            )
+        requests.append(build_request(**kw))
+    checked = assert_differential(engine, compiled, kernel, requests,
+                                  min_eligible=int(0.9 * len(requests)))
+    assert checked >= 360
+
+
+def test_wire_acl_differential():
+    """ACL rows through the NATIVE wire encoder: same arrays, same
+    eligibility, same kernel decisions as the Python encoder."""
+    from access_control_srv_tpu import native
+
+    if not native.available():
+        pytest.skip(f"native encoder unavailable: {native.build_error()}")
+    from .test_native_encoder import wire_roundtrip
+
+    engine, compiled, kernel = rig()
+    rng = random.Random(23)
+    OWNERS = ["SuperOrg1", "Org1", "Org2", "Org3", "otherOrg"]
+    requests = []
+    for i in range(80):
+        requests.append(build_request(
+            subject_id=rng.choice(["Alice", "Bob"]),
+            subject_role=rng.choice(["Admin", "SimpleUser"]),
+            role_scoping_entity=ORG,
+            role_scoping_instance=rng.choice(OWNERS),
+            resource_type=BUCKET, resource_id=f"res-{i % 5}",
+            action_type=URNS[rng.choice(
+                ["create", "read", "modify", "delete"])],
+            owner_indicatory_entity=ORG, owner_instance=rng.choice(OWNERS),
+            acl_indicatory_entity=rng.choice([ORG, USER]),
+            acl_instances=rng.sample(OWNERS + ["Alice", "Bob"],
+                                     rng.randint(1, 3)),
+        ))
+    enc = native.NativeBatchEncoder(compiled)
+    messages, twins = wire_roundtrip(requests)
+    nb = enc.encode_wire(messages)
+    pb_batch = encode_requests(twins, compiled)
+    assert np.array_equal(nb.eligible, pb_batch.eligible)
+    assert nb.eligible.all()
+    for name in ("r_acl_short", "r_acl_ent", "r_acl_inst", "r_acl_hr",
+                 "r_hr_roles", "r_subject_id"):
+        assert np.array_equal(nb.arrays[name], pb_batch.arrays[name]), name
+    decision, _, status = kernel.evaluate(nb)
+    for b, twin in enumerate(twins):
+        expected = engine.is_allowed(twin)
+        assert decision[b] == DEC_CODE[expected.decision], b
+        assert status[b] == expected.operation_status.code, b
