@@ -1,0 +1,38 @@
+"""Isolate the red differential case: tree round 2 (seed 1234), request 10
+of grid_requests(n=60, seed=1002)."""
+import random
+import json
+
+import tests.conftest  # noqa: F401  (force CPU platform)
+from access_control_srv_tpu.core import AccessController
+from access_control_srv_tpu.core.loader import load_policy_sets
+from access_control_srv_tpu.ops import DecisionKernel, compile_policies, encode_requests
+from tests.test_kernel_differential import _random_policy_tree, grid_requests
+
+rng = random.Random(1234)
+docs = [_random_policy_tree(rng) for _ in range(12)]
+doc = docs[2]
+print(json.dumps(doc, indent=1))
+
+engine = AccessController()
+for ps in load_policy_sets(doc):
+    engine.update_policy_set(ps)
+compiled = compile_policies(engine.policy_sets, engine.urns)
+assert compiled.supported
+
+requests = grid_requests(n=60, seed=1002)
+req = requests[10]
+print("\n=== REQUEST 10 ===")
+print("target.subjects:", [(a.id, a.value) for a in req.target.subjects])
+print("target.resources:", [(a.id, a.value) for a in req.target.resources])
+print("target.actions:", [(a.id, a.value) for a in req.target.actions])
+print("context:", json.dumps(req.context, indent=1, default=str))
+
+expected = engine.is_allowed(req)
+print("\noracle:", expected.decision, expected.operation_status)
+
+kernel = DecisionKernel(compiled)
+batch = encode_requests([req], compiled)
+print("eligible:", batch.eligible[0])
+decision, cacheable, status = kernel.evaluate(batch)
+print("kernel decision:", decision[0], "cacheable:", cacheable[0], "status:", status[0])
