@@ -159,11 +159,12 @@ class NativeBatchEncoder:
         urn_ids = np.array(
             [interner.intern(urns.get(name)) for name in _URN_ORDER], np.int32
         )
-        from ..core.hierarchical_scope import split_entity_urn
+        from ..ops.encode import urn_tail
 
-        # vocab tails are the entity NAMES (split_entity_urn()[1], the
-        # last-dot segment), matching the Python encoder's relevance check
-        tails = [split_entity_urn(v)[1] for v in compiled.entity_vocab]
+        # vocab tails use the reference's entity_name (after-last-colon
+        # segment), matching the Python encoder's relevance check and the
+        # compiled table's t_ent_tails
+        tails = [urn_tail(v) for v in compiled.entity_vocab]
         vocab_tails = np.array(
             [interner.intern(t) for t in tails], np.int32
         )

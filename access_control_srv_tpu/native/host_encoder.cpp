@@ -478,13 +478,13 @@ struct OutArrays {
   int32_t* batch_entities;   // [B * NR] distinct entity interner ids out
 };
 
-// entity tail: last '.'-segment of the pattern after the last ':'
-// (mirrors core/hierarchical_scope.py:split_entity_urn()[1])
+// entity tail: URN segment after the last ':' -- the reference's
+// entity_name in the property-relevance check (accessController.ts:515-516).
+// Mirrors ops/encode.py:urn_tail and StringInterner.tail_id so r_prop_tail
+// compares against the compiled table's t_ent_tails.
 std::string entity_tail(const std::string& value) {
   size_t colon = value.rfind(':');
-  std::string pattern = colon == std::string::npos ? value : value.substr(colon + 1);
-  size_t dot = pattern.rfind('.');
-  return dot == std::string::npos ? pattern : pattern.substr(dot + 1);
+  return colon == std::string::npos ? value : value.substr(colon + 1);
 }
 
 const JValue* jget(const JValue* v, std::string_view key) {

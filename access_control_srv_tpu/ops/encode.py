@@ -32,7 +32,7 @@ import numpy as np
 
 from ..core.common import find_ctx_resource, get_field
 from ..core.conditions import condition_matches
-from ..core.hierarchical_scope import regex_entity_compare, split_entity_urn
+from ..core.hierarchical_scope import regex_entity_compare
 from ..models.model import Request
 from .compile import CompiledPolicies
 from .interner import ABSENT
@@ -51,6 +51,14 @@ NROLE = 4   # subject roles
 NACLE = 4   # distinct ACL scoping entities per request
 NACLI = 8   # ACL instances per scoping entity
 NHRR = 8    # distinct HR-tree roles (verifyACL flatten) per request
+
+
+def urn_tail(value: str) -> str:
+    """The reference's ``entity_name`` in the property-relevance check: the
+    URN segment after the last ':' (accessController.ts:515-516).  Must match
+    StringInterner.tail_id so r_prop_tail compares against t_ent_tails."""
+    value = value or ""
+    return value[value.rfind(":") + 1:] if ":" in value else value
 
 
 @dataclass
@@ -212,7 +220,7 @@ def encode_requests(
     batch_entity_idx: dict[str, int] = {}
     # substring-relevance verification cache: (vocab tail, prop value)
     relevance_ok: dict[tuple[str, str], bool] = {}
-    vocab_tails = [split_entity_urn(v)[1] for v in compiled.entity_vocab]
+    vocab_tails = [urn_tail(v) for v in compiled.entity_vocab]
     # two distinct target entity values sharing a tail would make substring
     # relevance ambiguous against id equality
     tails_ambiguous = len(set(vocab_tails)) != len(vocab_tails)
@@ -310,7 +318,7 @@ def encode_requests(
                 key = (vt, value)
                 good = relevance_ok.get(key)
                 if good is None:
-                    prop_tail = split_entity_urn(value.split("#", 1)[0])[1]
+                    prop_tail = urn_tail(value.split("#", 1)[0])
                     good = (vt in value) == (vt == prop_tail)
                     relevance_ok[key] = good
             # any pair breaking the equivalence disqualifies the request
@@ -428,7 +436,7 @@ def encode_requests(
             a["r_prop_sfx"][b, j] = compiled.interner.suffix_id[vid]
             a["r_prop_run"][b, j] = run_idx
             prefix = value.split("#", 1)[0]
-            a["r_prop_tail"][b, j] = it(split_entity_urn(prefix)[1])
+            a["r_prop_tail"][b, j] = it(urn_tail(prefix))
         for j, op_value in enumerate(ops):
             a["r_op_vals"][b, j] = it(op_value)
             ctx_res = None
