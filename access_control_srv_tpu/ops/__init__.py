@@ -5,6 +5,7 @@ from .interner import StringInterner
 from .compile import CompiledPolicies, compile_policies
 from .encode import RequestBatch, encode_requests
 from .kernel import DecisionKernel
+from .prefilter import PrefilteredKernel
 
 __all__ = [
     "StringInterner",
@@ -13,4 +14,5 @@ __all__ = [
     "RequestBatch",
     "encode_requests",
     "DecisionKernel",
+    "PrefilteredKernel",
 ]

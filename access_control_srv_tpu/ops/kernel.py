@@ -52,6 +52,17 @@ def bake_policy_constants(compiled: CompiledPolicies) -> bool:
     return policy_bytes <= CONSTANT_BAKE_LIMIT_BYTES
 
 
+def tree_needs_hr(arrays: dict) -> bool:
+    """Static gate for stage B: only target rows carrying BOTH subjects
+    and a scoping entity can fail the HR check (hr_trivial covers every
+    other row), so trees without such rows skip the owner-check tensors
+    entirely (see _match_targets with_hr)."""
+    return bool(
+        (np.asarray(arrays["t_has_scoping"])
+         & (np.asarray(arrays["t_n_subjects"]) > 0)).any()
+    )
+
+
 def pow2_bucket(n: int, floor: int = 8) -> int:
     """Smallest power of two >= n (min `floor`): the shared padding bucket
     used by every kernel entry so varying batch/entity sizes reuse a
@@ -263,12 +274,18 @@ def _acl_pass(c: dict, r: dict, with_acl: bool):
     return skip | (short == 1) | ((short == 0) & pair_ok)
 
 
-def _match_targets(c: dict, r: dict):
+def _match_targets(c: dict, r: dict, with_hr: bool = True):
     """Stages A (target matching) + B (HR scopes) for one request: returns
     per-target-row match vectors the rule/policy stages gather from.
 
     Factored out so the rule-sharded kernel (parallel/rule_shard.py) can run
-    it against a per-device compacted target subtable."""
+    it against a per-device compacted target subtable.
+
+    ``with_hr=False`` skips stage B entirely: exact whenever no target row
+    carries both subjects and a scoping entity (then ``hr_trivial`` is True
+    for every row and hr_pass degenerates to all-ones); callers assert that
+    tree property statically so XLA never materializes the owner-check
+    tensors."""
     T = c["t_role"].shape[0]
 
     # ---------------------------------------------------------------- A: targets
@@ -384,6 +401,14 @@ def _match_targets(c: dict, r: dict):
     tm_rg_d = base & res_rg_d
 
     # ------------------------------------------------------------- B: HR scopes
+    if not with_hr:
+        return {
+            "tm_ex_p": tm_ex_p,
+            "tm_ex_d": tm_ex_d,
+            "tm_rg_p": tm_rg_p,
+            "tm_rg_d": tm_rg_d,
+            "hr_pass": jnp.ones((T,), bool),
+        }
     # collection per (target, entity slot, run) with sticky state like the
     # reference HR loop (exact OR regex sets, prefix mismatch resets,
     # reference: hierarchicalScope.ts:61-124)
@@ -625,17 +650,20 @@ def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     return decision, cacheable
 
 
-def _evaluate_one(c: dict, r: dict, with_acl: bool = True):
+def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
+                  with_hr: bool = True):
     """Decision for a single encoded request; vmapped over the batch.
 
     ``c``: compiled policy arrays (replicated across devices).
     ``r``: per-request encoded arrays.
     ``with_acl``: compile the full verifyACL stage (exact when ACL pairs
     are present; batches without pairs may use the cheaper False variant).
+    ``with_hr``: compile stage B (exact when some target row carries both
+    subjects and a scoping entity; see _match_targets).
     Returns (decision, cacheable, status_code) int32 scalars where
     decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
     """
-    m = _match_targets(c, r)
+    m = _match_targets(c, r, with_hr)
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(
         c, r, m, with_acl
     )
@@ -725,6 +753,7 @@ class DecisionKernel:
         self.compiled = compiled
         self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
         self._bake_constants = bake_policy_constants(compiled)
+        with_hr = tree_needs_hr(compiled.arrays)
 
         def make_run(with_acl: bool):
             def run(c, batch_arrays, rgx_set, pfx_neq,
@@ -736,7 +765,7 @@ class DecisionKernel:
                 def one(ra, rs, pn, ct, ca, cc):
                     rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
                           "cond_true": ct, "cond_abort": ca, "cond_code": cc}
-                    return _evaluate_one(c, rr, with_acl)
+                    return _evaluate_one(c, rr, with_acl, with_hr)
 
                 return jax.vmap(one, in_axes=in_axes)(
                     batch_arrays, rgx_set, pfx_neq,

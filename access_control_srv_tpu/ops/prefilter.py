@@ -1,0 +1,322 @@
+"""Host-side candidate pre-filtering for large rule counts.
+
+The dense kernel's per-request work is O(total target rows): every rule's
+target row is matched against every request even though a rule whose
+target names entity X can never match a request that only names entity Y
+(reference target semantics: a resource-bearing target matches only via an
+exact entity hit, a regex entity hit, or an operation hit —
+src/core/accessController.ts:465-654).  With 100k rules that dense sweep
+is the whole cost.
+
+This module restores O(matching rules): batch rows are grouped by their
+*resource signature* (distinct entity value ids + operation ids); for each
+signature the rule axis is compacted to the candidate subset
+
+  - rules with no target / no resource attributes (match anything),
+  - rules whose target entities exactly match a signature entity,
+  - rules whose target entities regex-match one (vocab regex matrices are
+    already computed per batch),
+  - rules whose target operations match a signature operation,
+
+left-packed along KR in original order.  Because combining algorithms are
+order-sensitive but only *relatively* so (first-DENY / first-PERMIT /
+first-applicable over collected rules, reference :846-893), dropping rules
+that provably cannot match and preserving relative order leaves every
+decision bit-identical.  Policy/set target rows are always retained, so
+set gates, policy gates, carried policyEffect and the multi-entity recheck
+(which reads policy-level arrays) are untouched.
+
+Execution is ONE device dispatch per batch: the signature subtrees are
+padded to a common shape and stacked on a leading group axis [G, ...];
+each request row carries its group index and gathers its own subtree
+inside the vmapped kernel.  Per-signature compacted trees and per-
+signature-set stacks are cached, so steady-state traffic pays neither
+compaction nor host->device transfer of policy data again.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compile import CompiledPolicies
+from .encode import RequestBatch
+from .kernel import (
+    DecisionKernel,
+    _evaluate_one,
+    pad_cols,
+    pow2_bucket,
+    tree_needs_hr,
+)
+
+_RULE_FIELDS = [
+    "rule_valid", "rule_effect", "rule_cacheable_raw", "rule_cacheable_eff",
+    "rule_has_target", "rule_target", "rule_cond",
+]
+
+
+def _is_varying(name: str) -> bool:
+    """Arrays that differ between signature subtrees (compacted rule axis,
+    compacted target subtable, remapped target indices); everything else is
+    group-invariant policy/set metadata shared across the stack."""
+    return (
+        name in _RULE_FIELDS
+        or name in ("pol_target", "set_target")
+        or name.startswith("t_")
+    )
+
+# rules below this count are cheaper to sweep densely than to group/compact
+MIN_RULES = 512
+
+
+def candidate_rows(
+    compiled: CompiledPolicies,
+    ent_ids: np.ndarray,
+    ent_cols: np.ndarray,
+    op_ids: np.ndarray,
+    act_vals: np.ndarray,
+    rgx_set: np.ndarray,
+) -> np.ndarray:
+    """[T] bool: target rows that could produce a match for a request
+    whose distinct entity value ids are ``ent_ids`` (batch entity columns
+    ``ent_cols``), operation ids ``op_ids`` and action attribute values
+    ``act_vals``.
+
+    Resource side: no-resource targets, exact entity hits, regex entity
+    hits, operation hits.  Action side: every target action attribute must
+    find an id+value pair in the request (kernel ``act_ok``), so a target
+    action VALUE absent from the request's action values disqualifies the
+    row — value-only filtering is conservative (id mismatches are left for
+    the kernel), which keeps signature aliasing safe."""
+    a = compiled.arrays
+    tv = a["t_ent_vals"]  # [T, K_ENT]
+    cand = a["t_n_res"] == 0
+    if ent_ids.size:
+        cand = cand | (np.isin(tv, ent_ids) & (tv >= 0)).any(axis=1)
+        # regex candidacy: any target vocab row regex-hits a batch entity col
+        w = a["t_ent_w"]  # [T, K_ENT]
+        hits = rgx_set[np.clip(w, 0, None)][:, :, ent_cols]  # [T, K, |cols|]
+        cand = cand | (hits & (w >= 0)[:, :, None]).any(axis=(1, 2))
+    if op_ids.size:
+        ov = a["t_op_vals"]
+        cand = cand | (np.isin(ov, op_ids) & (ov >= 0)).any(axis=1)
+    av = a["t_act_vals"]  # [T, K_ACT]
+    act_compat = ((av < 0) | np.isin(av, act_vals)).all(axis=1)
+    return cand & act_compat
+
+
+def compact_rules(
+    compiled: CompiledPolicies, row_cand: np.ndarray
+) -> CompiledPolicies:
+    """Left-pack candidate rules along KR (order-preserving) and compact
+    the target subtable to the rows the kept rules + all policy/set
+    targets reference.  Mirrors parallel/rule_shard.py:partition_rules'
+    compaction, but driven by candidacy instead of chunk boundaries."""
+    a = compiled.arrays
+    cand = a["rule_valid"] & (~a["rule_has_target"] | row_cand[a["rule_target"]])
+
+    counts = cand.sum(axis=2)
+    krp = pow2_bucket(int(counts.max()) if counts.size else 0, floor=4)
+    krp = min(krp, compiled.KR) if compiled.KR else krp
+    order = np.argsort(~cand, axis=2, kind="stable")  # candidates first
+    new: dict[str, np.ndarray] = {}
+    for name in _RULE_FIELDS:
+        new[name] = np.take_along_axis(a[name], order, axis=2)[:, :, :krp]
+    new["rule_valid"] = np.take_along_axis(cand, order, axis=2)[:, :, :krp]
+
+    needed = set(
+        np.unique(new["rule_target"][new["rule_valid"] & new["rule_has_target"]])
+    )
+    needed |= set(np.unique(a["pol_target"][a["pol_has_target"]]))
+    needed |= set(np.unique(a["set_target"][a["set_has_target"]]))
+    needed.add(0)  # row 0 backs the "no target" index
+    rows = sorted(needed)
+    remap = np.zeros(a["t_role"].shape[0], np.int64)
+    for j, old in enumerate(rows):
+        remap[old] = j
+    for name, arr in a.items():
+        if name.startswith("t_"):
+            new[name] = arr[rows]
+        elif name not in new:
+            new[name] = arr
+    new["rule_target"] = remap[new["rule_target"]].astype(np.int32)
+    new["pol_target"] = remap[a["pol_target"]].astype(np.int32)
+    new["set_target"] = remap[a["set_target"]].astype(np.int32)
+    return replace(compiled, arrays=new, KR=krp, T=len(rows))
+
+
+def _pad_sub(arr: np.ndarray, name: str, krp: int, tp: int) -> np.ndarray:
+    """Pad one compacted-subtree array to the stack's common KR/T."""
+    if name in _RULE_FIELDS:
+        width = krp - arr.shape[2]
+        if width > 0:
+            fill = (
+                False if arr.dtype == bool
+                else (0 if name in ("rule_effect", "rule_target") else -1)
+            )
+            arr = np.concatenate(
+                [arr, np.full(arr.shape[:2] + (width,), fill, arr.dtype)],
+                axis=2,
+            )
+        return arr
+    if name.startswith("t_") and arr.shape[0] < tp:
+        reps = np.repeat(arr[:1], tp - arr.shape[0], axis=0)
+        arr = np.concatenate([arr, reps], axis=0)
+    return arr
+
+
+class PrefilteredKernel:
+    """Drop-in DecisionKernel: groups the batch by resource signature,
+    compacts the rule axis per signature, and evaluates the whole batch in
+    one dispatch over stacked subtrees.  Decisions are bit-identical to
+    the dense kernel (differential: tests/test_prefilter.py); trees under
+    MIN_RULES rules skip the machinery entirely."""
+
+    def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024):
+        if not compiled.supported:
+            raise ValueError(
+                f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
+            )
+        self.compiled = compiled
+        self.cache_size = cache_size
+        self._subs: dict[tuple, CompiledPolicies] = {}
+        self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
+        self._dense: DecisionKernel | None = None
+        self._runs: dict[tuple, object] = {}
+        self.active = compiled.n_rules >= MIN_RULES
+        if not self.active:
+            self._dense = DecisionKernel(compiled)
+        self._c_inv = {
+            k: jnp.asarray(v) for k, v in compiled.arrays.items()
+            if not _is_varying(k)
+        }
+
+    def _runner(self, with_acl: bool, with_hr: bool):
+        key = (with_acl, with_hr)
+        run = self._runs.get(key)
+        if run is None:
+            c_inv = self._c_inv  # baked as jit constants: [S,KP]-scale only
+
+            def run(cs, g_idx, batch_arrays, rgx_set, pfx_neq,
+                    cond_true, cond_abort, cond_code):
+                def one(g, ra, ct, ca, cc):
+                    # per-row gather of the group-VARYING arrays only;
+                    # policy/set metadata is identical across subtrees
+                    c = {**c_inv,
+                         **jax.tree_util.tree_map(lambda x: x[g], cs)}
+                    rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq,
+                          "cond_true": ct, "cond_abort": ca, "cond_code": cc}
+                    return _evaluate_one(c, rr, with_acl, with_hr)
+
+                return jax.vmap(one)(
+                    g_idx, batch_arrays,
+                    cond_true.T, cond_abort.T, cond_code.T,
+                )
+
+            run = self._runs[key] = jax.jit(run)
+        return run
+
+    # ---------------------------------------------------------------- caches
+    def _sub(self, key, ent_ids, ent_cols, op_ids, act_vals,
+             rgx_set) -> CompiledPolicies:
+        sub = self._subs.pop(key, None)  # LRU: reinsert at the tail
+        if sub is None:
+            rows = candidate_rows(
+                self.compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
+            )
+            sub = compact_rules(self.compiled, rows)
+            if len(self._subs) >= self.cache_size:
+                self._subs.pop(next(iter(self._subs)))
+        self._subs[key] = sub
+        return sub
+
+    def _stack(
+        self, keys: tuple, subs: list[CompiledPolicies]
+    ) -> dict[str, jnp.ndarray]:
+        stacked = self._stacks.pop(keys, None)
+        if stacked is None:
+            krp = pow2_bucket(max(s.KR for s in subs), floor=4)
+            tp = pow2_bucket(max(s.T for s in subs), floor=8)
+            stacked = {
+                name: jnp.asarray(np.stack(
+                    [_pad_sub(s.arrays[name], name, krp, tp) for s in subs]
+                ))
+                for name in subs[0].arrays
+                if _is_varying(name)
+            }
+            if len(self._stacks) >= 16:
+                self._stacks.pop(next(iter(self._stacks)))
+        self._stacks[keys] = stacked
+        return stacked
+
+    # -------------------------------------------------------------- evaluate
+    def evaluate(self, batch: RequestBatch):
+        if not self.active:
+            return self._dense.evaluate(batch)
+
+        ents = np.asarray(batch.arrays["r_ent_vals"])  # [B, NR]
+        cols = np.asarray(batch.arrays["r_ent_e"])     # [B, NR]
+        ops = np.asarray(batch.arrays["r_op_vals"])    # [B, NOP]
+        acts = np.asarray(batch.arrays["r_act_vals"])  # [B, NACT]
+        B, NR = ents.shape
+        NOP = ops.shape[1]
+
+        sig = np.concatenate(
+            [np.sort(ents, 1), np.sort(ops, 1), np.sort(acts, 1)], axis=1
+        )
+        uniq, inv = np.unique(sig, axis=0, return_inverse=True)
+
+        # entity value id -> batch entity column (positional in the runs)
+        valid = ents >= 0
+        id_to_col = dict(zip(ents[valid].tolist(), cols[valid].tolist()))
+
+        rgx_np = np.asarray(batch.rgx_set)
+        keys = []
+        subs = []  # held directly: cache eviction cannot orphan this batch
+        for g in range(uniq.shape[0]):
+            sig_row = uniq[g]
+            ent_ids = np.unique(sig_row[:NR][sig_row[:NR] >= 0])
+            op_ids = np.unique(sig_row[NR:NR + NOP][sig_row[NR:NR + NOP] >= 0])
+            act_vals = np.unique(
+                sig_row[NR + NOP:][sig_row[NR + NOP:] >= 0]
+            )
+            ent_cols = np.array(
+                [id_to_col[int(e)] for e in ent_ids], np.int64
+            )
+            key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
+                   tuple(act_vals.tolist()), self.compiled.version)
+            subs.append(
+                self._sub(key, ent_ids, ent_cols, op_ids, act_vals, rgx_np)
+            )
+            keys.append(key)
+        stacked = self._stack(tuple(keys), subs)
+
+        bucket = pow2_bucket(B)
+
+        def pad_lead(a: np.ndarray) -> np.ndarray:
+            if a.shape[0] == bucket:
+                return a
+            fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
+            return np.concatenate([a, fill], axis=0)
+
+        e_bucket = pow2_bucket(rgx_np.shape[1])
+        g_idx = pad_lead(inv.astype(np.int32).reshape(B))
+        run = self._runner(
+            bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),
+            tree_needs_hr(stacked),
+        )
+        out = run(
+            stacked,
+            jnp.asarray(g_idx),
+            {k: jnp.asarray(pad_lead(np.asarray(v)))
+             for k, v in batch.arrays.items()},
+            jnp.asarray(pad_cols(rgx_np, e_bucket)),
+            jnp.asarray(pad_cols(np.asarray(batch.pfx_neq), e_bucket)),
+            jnp.asarray(pad_cols(batch.cond_true, bucket)),
+            jnp.asarray(pad_cols(batch.cond_abort, bucket)),
+            jnp.asarray(pad_cols(batch.cond_code, bucket)),
+        )
+        return tuple(np.asarray(x)[:B] for x in out)

@@ -435,12 +435,9 @@ def _stress_engine(n_rules: int):
 
 
 def bench_stress():
-    import jax
-    import jax.numpy as jnp
-
     from access_control_srv_tpu.models import Attribute, Request, Target, Urns
     from access_control_srv_tpu.ops import (
-        DecisionKernel,
+        PrefilteredKernel,
         compile_policies,
         encode_requests,
     )
@@ -455,7 +452,9 @@ def bench_stress():
     compiled = compile_policies(engine.policy_sets, engine.urns)
     assert compiled.supported, compiled.unsupported_reason
     compile_s = time.perf_counter() - t0
-    kernel = DecisionKernel(compiled)
+    # candidate pre-filter: per-request work scales with matching rules,
+    # not total rules (ops/prefilter.py; differential: tests/test_prefilter.py)
+    kernel = PrefilteredKernel(compiled)
 
     base = chunk
     requests = []
@@ -497,20 +496,9 @@ def bench_stress():
             )
         )
     batch = encode_requests(requests, compiled)
-    args = (
-        {k: jnp.asarray(v) for k, v in batch.arrays.items()},
-        jnp.asarray(batch.rgx_set),
-        jnp.asarray(batch.pfx_neq),
-        jnp.asarray(batch.cond_true),
-        jnp.asarray(batch.cond_abort),
-        jnp.asarray(batch.cond_code),
-    )
-    out = kernel._run(*args)
-    jax.block_until_ready(out)
+    # warmup: compiles every per-signature subtree kernel once
+    dec, _, _ = kernel.evaluate(batch)
     # sanity: kernel vs oracle on a scalar sample
-    dec = np.asarray(out[0])
-    from access_control_srv_tpu.models import Decision
-
     code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
     for i in range(0, base, max(1, base // 16)):
         expected = engine.is_allowed(requests[i])
@@ -519,8 +507,7 @@ def bench_stress():
     iters = max(1, total // base)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = kernel._run(*args)
-    jax.block_until_ready(out)
+        out = kernel.evaluate(batch)
     elapsed = time.perf_counter() - t0
     return _result(
         f"isAllowed decisions/sec/chip ({actual_rules}-rule synthetic stress)",
@@ -528,6 +515,7 @@ def bench_stress():
         "decisions/s",
         {"rules": actual_rules, "batch": base, "iters": iters,
          "host_compile_s": round(compile_s, 2),
+         "prefilter_subtrees": len(kernel._subs),
          "eligible_pct": round(100.0 * float(batch.eligible.mean()), 1)},
     )
 

@@ -1,0 +1,219 @@
+"""PrefilteredKernel differential tests: candidate-compacted evaluation
+must be bit-identical to the dense kernel (and hence the oracle) — the
+pre-filter drops only rules that provably cannot match.
+
+This is the rule-count scaling path (BASELINE config 5: large rule trees);
+correctness here is what allows the stress bench to run compacted."""
+
+import random
+
+import numpy as np
+import pytest
+
+from access_control_srv_tpu.core import AccessController
+from access_control_srv_tpu.core.loader import load_policy_sets
+from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    PrefilteredKernel,
+    compile_policies,
+    encode_requests,
+)
+from access_control_srv_tpu.ops import prefilter as PF
+
+from .test_kernel_differential import DEC_CODE, grid_requests
+from .utils import URNS, make_engine
+
+PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+DO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:deny-overrides"
+FA = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:first-applicable"
+
+
+def force_active(kern: PrefilteredKernel) -> PrefilteredKernel:
+    """Fixture trees sit under MIN_RULES; exercise the machinery anyway."""
+    if not kern.active:
+        kern.active = True
+        kern._dense = None
+    return kern
+
+
+@pytest.mark.parametrize(
+    "fixture_name",
+    [
+        "basic_policies.yml",
+        "policy_targets.yml",
+        "policy_set_targets.yml",
+        "role_scopes.yml",
+        "conditions.yml",
+        "acl_policies.yml",
+        "props_multi_rules_entities.yml",
+        "ops_multi.yml",
+    ],
+)
+def test_prefilter_matches_dense(fixture_name):
+    engine = make_engine(fixture_name)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    dense = DecisionKernel(compiled)
+    pre = force_active(PrefilteredKernel(compiled))
+
+    batch = encode_requests(grid_requests(n=120, seed=53), compiled)
+    dd, dc, ds = dense.evaluate(batch)
+    pd_, pc, ps = pre.evaluate(batch)
+    el = batch.eligible
+    assert np.array_equal(dd[el], pd_[el])
+    assert np.array_equal(dc[el], pc[el])
+    assert np.array_equal(ds[el], ps[el])
+
+
+def _stress_doc(n_policies=6, per_policy=120, n_entities=16):
+    urns = Urns()
+    entities = [
+        f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+        for k in range(n_entities)
+    ]
+    actions = [urns["read"], urns["modify"], urns["create"], urns["delete"]]
+    policies = []
+    rid = 0
+    for p in range(n_policies):
+        rules = []
+        for q in range(per_policy):
+            rules.append({
+                "id": f"r{rid}",
+                "target": {
+                    "subjects": [
+                        {"id": urns["role"], "value": f"role-{rid % 23}"}
+                    ],
+                    "resources": [
+                        {"id": urns["entity"],
+                         "value": entities[(p * 31 + q) % n_entities]}
+                    ],
+                    "actions": [
+                        {"id": urns["actionID"],
+                         "value": actions[rid % len(actions)]}
+                    ],
+                },
+                "effect": "PERMIT" if rid % 3 else "DENY",
+            })
+            rid += 1
+        policies.append(
+            {"id": f"p{p}", "combining_algorithm": PO, "rules": rules}
+        )
+    return {"policy_sets": [
+        {"id": "stress", "combining_algorithm": DO, "policies": policies}
+    ]}, entities, actions
+
+
+def test_prefilter_stress_differential():
+    """Large synthetic tree (~720 rules, above MIN_RULES): prefiltered
+    decisions equal dense kernel AND the scalar oracle."""
+    urns = Urns()
+    doc, entities, actions = _stress_doc()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    dense = DecisionKernel(compiled)
+    pre = PrefilteredKernel(compiled)
+    assert pre.active  # above MIN_RULES
+
+    rng = random.Random(5)
+    requests = []
+    for i in range(200):
+        ent = rng.choice(entities)
+        requests.append(Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=urns["role"], value=f"role-{i % 29}"),
+                    Attribute(id=urns["subjectID"], value=f"u{i}"),
+                ],
+                resources=[
+                    Attribute(id=urns["entity"], value=ent),
+                    Attribute(id=urns["resourceID"], value=f"id-{i}"),
+                ],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=rng.choice(actions))],
+            ),
+            context={
+                "resources": [],
+                "subject": {
+                    "id": f"u{i}",
+                    "role_associations": [
+                        {"role": f"role-{i % 29}", "attributes": []}
+                    ],
+                    "hierarchical_scopes": [],
+                },
+            },
+        ))
+    batch = encode_requests(requests, compiled)
+    assert batch.eligible.all()
+    dd, dc, ds = dense.evaluate(batch)
+    pd_, pc, ps = pre.evaluate(batch)
+    assert np.array_equal(dd, pd_)
+    assert np.array_equal(dc, pc)
+    assert np.array_equal(ds, ps)
+    for b in (0, 7, 63, 199):  # spot-check the oracle on a few rows
+        assert pd_[b] == DEC_CODE[engine.is_allowed(requests[b]).decision]
+    # compaction really happened: per-entity subtrees are much smaller
+    sub = next(iter(pre._subs.values()))
+    assert sub.KR < compiled.KR / 2
+    assert sub.T < compiled.T / 2
+
+
+def test_prefilter_cache_reuse():
+    doc, entities, actions = _stress_doc(n_policies=5, per_policy=110)
+    urns = Urns()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    pre = PrefilteredKernel(compiled)
+
+    def mk(ent):
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=urns["subjectID"], value="u")],
+                resources=[Attribute(id=urns["entity"], value=ent)],
+                actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+            ),
+            context={"resources": [], "subject": {"id": "u"}},
+        )
+
+    b1 = encode_requests([mk(entities[0]), mk(entities[1])], compiled)
+    pre.evaluate(b1)
+    n = len(pre._subs)
+    assert n == 2  # one subtree per signature
+    b2 = encode_requests([mk(entities[1]), mk(entities[0])], compiled)
+    pre.evaluate(b2)
+    assert len(pre._subs) == n  # second batch reuses the cache
+
+
+def test_prefilter_batch_larger_than_cache():
+    """One batch with more signatures than cache_size must not orphan its
+    own subtrees (the eviction KeyError found in round-3 review)."""
+    doc, entities, actions = _stress_doc(n_policies=5, per_policy=110)
+    urns = Urns()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    pre = PrefilteredKernel(compiled, cache_size=2)
+    dense = DecisionKernel(compiled)
+
+    def mk(ent, act):
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=urns["subjectID"], value="u")],
+                resources=[Attribute(id=urns["entity"], value=ent)],
+                actions=[Attribute(id=urns["actionID"], value=act)],
+            ),
+            context={"resources": [], "subject": {"id": "u"}},
+        )
+
+    reqs = [mk(entities[i % 8], actions[i % 4]) for i in range(32)]
+    batch = encode_requests(reqs, compiled)
+    pd_, pc, ps_ = pre.evaluate(batch)  # 8x4 signatures > cache_size=2
+    dd, dc, ds = dense.evaluate(batch)
+    assert np.array_equal(pd_, dd)
+    assert len(pre._subs) <= 2

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Round-long TPU capture loop (VERDICT r2 item 2).
+
+The machine's TPU plugin can wedge on init for hours at a time; the first
+window when the chip answers must produce committed benchmark evidence.
+This script probes the backend out-of-process every PROBE_INTERVAL seconds,
+appends every attempt to TPU_PROBE_LOG.jsonl (timestamped proof of chip
+availability over the round), and on first success runs bench.py and
+bench_all.py and commits the artifacts.
+
+Run detached:  nohup python tpu_probe_loop.py >/dev/null 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from bench import probe_backend  # noqa: E402
+
+LOG = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
+PROBE_INTERVAL = int(os.environ.get("PROBE_INTERVAL", 300))
+MAX_HOURS = float(os.environ.get("PROBE_MAX_HOURS", 11.0))
+
+
+def log_attempt(entry: dict) -> None:
+    entry["ts"] = datetime.now(timezone.utc).isoformat(timespec="seconds")
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def run_and_capture() -> bool:
+    """Chip is up: run the headline bench and the evidence matrix."""
+    ok = True
+    env = dict(os.environ)
+    env.pop("BENCH_SKIP_PROBE", None)
+    try:
+        head = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=1800, env=env,
+        )
+        line = (head.stdout or "").strip().splitlines()
+        if head.returncode == 0 and line:
+            with open(os.path.join(REPO, "BENCH_TPU_CAPTURE.json"), "w") as f:
+                f.write(line[-1] + "\n")
+        else:
+            ok = False
+            log_attempt({"phase": "bench.py", "rc": head.returncode,
+                         "err": (head.stderr or "")[-400:]})
+    except subprocess.TimeoutExpired:
+        ok = False
+        log_attempt({"phase": "bench.py", "err": "bench timeout 1800s"})
+    try:
+        matrix = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_all.py")],
+            capture_output=True, text=True, timeout=3600, env=env,
+        )
+        if matrix.returncode != 0:
+            ok = False
+            log_attempt({"phase": "bench_all.py", "rc": matrix.returncode,
+                         "err": (matrix.stderr or "")[-400:]})
+    except subprocess.TimeoutExpired:
+        ok = False
+        log_attempt({"phase": "bench_all.py", "err": "bench_all timeout 3600s"})
+    return ok
+
+
+def commit_artifacts() -> None:
+    files = ["TPU_PROBE_LOG.jsonl", "BENCH_TPU_CAPTURE.json", "BENCH_ALL.json"]
+    present = [f for f in files if os.path.exists(os.path.join(REPO, f))]
+    for attempt in range(10):
+        add = subprocess.run(["git", "-C", REPO, "add", *present],
+                             capture_output=True)
+        if add.returncode != 0:
+            time.sleep(30)
+            continue
+        cm = subprocess.run(
+            ["git", "-C", REPO, "commit", "-m",
+             "Capture TPU benchmark evidence on chip-up window"],
+            capture_output=True,
+        )
+        if cm.returncode == 0:
+            return
+        time.sleep(30)
+
+
+def main() -> None:
+    deadline = time.time() + MAX_HOURS * 3600
+    while time.time() < deadline:
+        info, err = probe_backend(timeout=120, retries=1)
+        if info is not None:
+            log_attempt({"ok": True, **info})
+            captured = run_and_capture()
+            commit_artifacts()
+            if captured:
+                return
+            # partial failure: keep probing, maybe a later window is cleaner
+        else:
+            log_attempt({"ok": False, "err": err})
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
