@@ -45,7 +45,6 @@ _ARRAY_ORDER = [
     "r_op_owner_ent", "r_op_owner_inst",
     "r_ra3", "r_ra2", "r_n_ra", "r_hr",
     "r_ctx_present", "r_n_entity_attrs", "r_has_props", "r_has_target",
-    "r_has_idop", "r_action_crud",
     "r_acl_short", "r_acl_ent", "r_acl_inst", "r_acl_hr", "r_hr_roles",
     "r_subject_id",
 ]

@@ -425,6 +425,8 @@ struct Encoder {
   // urn ids (into interner): see acs_enc_create for the order
   int32_t urn_entity, urn_property, urn_operation, urn_resource_id;
   int32_t urn_role, urn_scoping, urn_scoping_inst, urn_owner_ent, urn_owner_inst;
+  // urn_action_id / crud mirror _URN_ORDER slots 9-13; currently unread
+  // (the kernel derives action kind from acl_consts on device)
   int32_t urn_action_id;
   int32_t crud[4];
   int32_t urn_acl_ind, urn_acl_inst;
@@ -466,8 +468,6 @@ struct OutArrays {
   int32_t* r_n_entity_attrs; // [B]
   uint8_t* r_has_props;      // [B]
   uint8_t* r_has_target;     // [B]
-  uint8_t* r_has_idop;       // [B]
-  uint8_t* r_action_crud;    // [B]
   int32_t* r_acl_short;      // [B] 0 pairs / 1 early all-clear / 2 malformed
   int32_t* r_acl_ent;        // [B, NACLE]
   int32_t* r_acl_inst;       // [B, NACLE, NACLI]
@@ -674,8 +674,6 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
   o.r_n_entity_attrs = (int32_t*)ptrs[pi++];
   o.r_has_props = (uint8_t*)ptrs[pi++];
   o.r_has_target = (uint8_t*)ptrs[pi++];
-  o.r_has_idop = (uint8_t*)ptrs[pi++];
-  o.r_action_crud = (uint8_t*)ptrs[pi++];
   o.r_acl_short = (int32_t*)ptrs[pi++];
   o.r_acl_ent = (int32_t*)ptrs[pi++];
   o.r_acl_inst = (int32_t*)ptrs[pi++];
@@ -908,8 +906,16 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
     if (acl_short == 0) {
       bool over = (int)acl_ents.size() > NACLE;
       for (auto& insts : acl_insts) over |= (int)insts.size() > NACLI;
-      if (over) {
-        o.eligible[b] = 0;  // ACL shape beyond caps: fallback
+      // a missing/non-string ACL entity or instance value interns to
+      // ABSENT; the kernel's validity masks would silently drop it and
+      // pass where the reference fails closed (verifyACL.ts keys its map
+      // on undefined) -- fall back to the oracle instead
+      bool absent = false;
+      for (int32_t e : acl_ents) absent |= e < 0;
+      for (auto& insts : acl_insts)
+        for (int32_t i : insts) absent |= i < 0;
+      if (over || absent) {
+        o.eligible[b] = 0;  // ACL shape beyond caps/ABSENT values: fallback
         continue;
       }
       for (size_t e = 0; e < acl_ents.size(); ++e) {
@@ -924,18 +930,6 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
     o.r_ctx_present[b] = req.has_context ? 1 : 0;
     o.r_n_entity_attrs[b] = (int32_t)runs.size();
     o.r_has_props[b] = props.empty() ? 0 : 1;
-    bool has_idop = !ops.empty();
-    for (const Attr& attr : req.resources)
-      has_idop |= attr.id == s_resource_id;
-    o.r_has_idop[b] = has_idop ? 1 : 0;
-    if (!req.actions.empty()) {
-      const Attr& first = req.actions[0];
-      if (first.id == enc.interner.strings[enc.urn_action_id]) {
-        int32_t vid = enc.interner.intern(first.value);
-        for (int i = 0; i < 4; ++i)
-          if (vid == enc.crud[i]) { o.r_action_crud[b] = 1; break; }
-      }
-    }
 
     int inst_slot = 0;
     bool overflow = false;

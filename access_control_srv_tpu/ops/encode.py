@@ -165,18 +165,12 @@ def alloc_row_arrays(B: int) -> dict[str, np.ndarray]:
         "r_n_entity_attrs": np.zeros((B,), np.int32),
         "r_has_props": np.zeros((B,), bool),
         "r_has_target": np.zeros((B,), bool),
-        # verify_acl no-ACL failure-path inputs (reference: verifyACL.ts):
-        # any resourceID/operation attribute triggers the early all-clear
-        # when ACL metadata is absent (:56-59); otherwise empty role
-        # associations fail (:96-100) and only CRUD actions pass (:148-248)
-        "r_has_idop": np.zeros((B,), bool),
-        "r_action_crud": np.zeros((B,), bool),
         # verify_acl ACL-pair inputs (reference: verifyACL.ts:37-88,
         # 119-136, 148-248). acl_short: 0 = pairs mode, 1 = early all-clear
         # (a targeted resource without ACL metadata, :56-59), 2 = malformed
-        # ACL fail (:72-82). The native (C++) wire encoder does not fill
-        # these: it marks ACL-carrying rows ineligible, leaving the
-        # defaults, which read as "no pairs".
+        # ACL fail (:72-82). Both encoders (Python and the C++ wire
+        # encoder) fill these; only over-cap, ABSENT-valued or
+        # malformed-JSON ACL shapes fall back to the scalar oracle.
         "r_acl_short": np.zeros((B,), np.int32),
         "r_acl_ent": np.full((B, NACLE), ABSENT, np.int32),
         "r_acl_inst": np.full((B, NACLE, NACLI), ABSENT, np.int32),
@@ -207,13 +201,8 @@ def encode_requests(
     scoping_inst_urn = urns.get("roleScopingInstance")
     owner_ent_urn = urns.get("ownerEntity")
     owner_inst_urn = urns.get("ownerInstance")
-    action_id_urn = urns.get("actionID")
     acl_ind_urn = urns.get("aclIndicatoryEntity")
     acl_inst_urn = urns.get("aclInstance")
-    crud_actions = {
-        urns.get("create"), urns.get("read"),
-        urns.get("modify"), urns.get("delete"),
-    }
 
     rgx = _RegexCache(compiled.entity_vocab)
     batch_entity_values: list[str] = []
@@ -388,6 +377,16 @@ def encode_requests(
         ):
             mark(b)  # ACL shape beyond caps: oracle fallback
             continue
+        if acl_short == 0 and (
+            any(e < 0 for e in acl_ents)
+            or any(i < 0 for insts in acl_insts for i in insts)
+        ):
+            # a None/missing ACL entity or instance value interns to ABSENT;
+            # the kernel's validity masks would silently drop it and pass
+            # where the reference fails closed (verifyACL.ts keys its map on
+            # undefined) -- fall back to the oracle instead
+            mark(b)
+            continue
         a["r_acl_short"][b] = acl_short
         if acl_short == 0:
             for j, ent_id in enumerate(acl_ents):
@@ -400,15 +399,6 @@ def encode_requests(
         a["r_ctx_present"][b] = bool(context)
         a["r_n_entity_attrs"][b] = len(runs)
         a["r_has_props"][b] = len(props) > 0
-        a["r_has_idop"][b] = len(ops) > 0 or any(
-            attr.id == resource_id_urn for attr in (target.resources or [])
-        )
-        first_action = acts[0] if acts else None
-        a["r_action_crud"][b] = (
-            first_action is not None
-            and first_action.id == action_id_urn
-            and first_action.value in crud_actions
-        )
 
         inst_slot = 0
         overflow = False

@@ -412,3 +412,60 @@ def test_multi_entity_property_relevance_regression():
     assert engine.is_allowed(good).decision == "PERMIT"
     n = run_differential(engine, [bad, good])
     assert n == 2
+
+
+def test_acl_absent_values_fall_back():
+    """ADVICE r2 (high): an ACL entry whose aclIndicatoryEntity or
+    aclInstance value is None interns to ABSENT; the kernel's validity
+    masks would silently drop the entity/instance and pass verifyACL where
+    the reference fails closed.  Such rows must be marked ineligible
+    (oracle fallback), not evaluated on device."""
+    engine = make_engine("acl_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+
+    def mk(acls):
+        return Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=URNS["role"], value="member"),
+                    Attribute(id=URNS["subjectID"], value="ada"),
+                ],
+                resources=[
+                    Attribute(id=URNS["entity"], value=ORG),
+                    Attribute(id=URNS["resourceID"], value="res-1"),
+                ],
+                actions=[Attribute(id=URNS["actionID"], value=URNS["create"])],
+            ),
+            context={
+                "resources": [{"id": "res-1", "meta": {"owners": [],
+                                                       "acls": acls}}],
+                "subject": {
+                    "id": "ada",
+                    "role_associations": [
+                        {"role": "member", "attributes": []}
+                    ],
+                    "hierarchical_scopes": [],
+                },
+            },
+        )
+
+    none_entity = mk([{
+        "id": URNS["aclIndicatoryEntity"], "value": None,
+        "attributes": [{"id": URNS["aclInstance"], "value": "ada"}],
+    }])
+    none_instance = mk([{
+        "id": URNS["aclIndicatoryEntity"], "value": USER,
+        "attributes": [{"id": URNS["aclInstance"], "value": None}],
+    }])
+    control = mk([{
+        "id": URNS["aclIndicatoryEntity"], "value": USER,
+        "attributes": [{"id": URNS["aclInstance"], "value": "ada"}],
+    }])
+    batch = encode_requests([none_entity, none_instance, control], compiled)
+    assert not batch.eligible[0]  # ABSENT entity value: oracle fallback
+    assert not batch.eligible[1]  # ABSENT instance value: oracle fallback
+    assert batch.eligible[2]
+    # the oracle itself must not crash on the degenerate shapes
+    for req in (none_entity, none_instance):
+        engine.is_allowed(req)
+    run_differential(engine, [none_entity, none_instance, control])

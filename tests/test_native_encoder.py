@@ -323,3 +323,60 @@ def test_strict_string_parsing_matches_json_loads():
     n_ineligible = len(bad) + len(conservative)
     assert not nb.eligible[:n_ineligible].any()
     assert nb.eligible[n_ineligible:].all()
+
+
+def test_wire_acl_absent_values_ineligible():
+    """ADVICE r2 (high), native side: JSON null ACL entity/instance values
+    reach intern_jstr as ABSENT; such rows must fall back to the oracle
+    (eligible=False), matching the Python encoder."""
+    from access_control_srv_tpu.models import Attribute, Request, Target
+
+    from .utils import URNS
+
+    ORG = "urn:restorecommerce:acs:model:organization.Organization"
+    USER = "urn:restorecommerce:acs:model:user.User"
+    engine = make_engine("acl_policies.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+
+    def mk(acls):
+        return Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=URNS["role"], value="member"),
+                    Attribute(id=URNS["subjectID"], value="ada"),
+                ],
+                resources=[
+                    Attribute(id=URNS["entity"], value=ORG),
+                    Attribute(id=URNS["resourceID"], value="res-1"),
+                ],
+                actions=[Attribute(id=URNS["actionID"], value=URNS["create"])],
+            ),
+            context={
+                "resources": [{"id": "res-1", "meta": {"owners": [],
+                                                       "acls": acls}}],
+                "subject": {
+                    "id": "ada",
+                    "role_associations": [
+                        {"role": "member", "attributes": []}
+                    ],
+                    "hierarchical_scopes": [],
+                },
+            },
+        )
+
+    requests = [
+        mk([{"id": URNS["aclIndicatoryEntity"], "value": None,
+             "attributes": [{"id": URNS["aclInstance"], "value": "ada"}]}]),
+        mk([{"id": URNS["aclIndicatoryEntity"], "value": USER,
+             "attributes": [{"id": URNS["aclInstance"], "value": None}]}]),
+        mk([{"id": URNS["aclIndicatoryEntity"], "value": USER,
+             "attributes": [{"id": URNS["aclInstance"], "value": "ada"}]}]),
+    ]
+    messages, twins = wire_roundtrip(requests)
+    nb = enc.encode_wire(messages)
+    pb_batch = encode_requests(twins, compiled)
+    assert not nb.eligible[0]
+    assert not nb.eligible[1]
+    assert nb.eligible[2]
+    assert np.array_equal(nb.eligible, pb_batch.eligible)
