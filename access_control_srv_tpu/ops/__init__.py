@@ -6,6 +6,7 @@ from .compile import CompiledPolicies, compile_policies
 from .encode import RequestBatch, encode_requests
 from .kernel import DecisionKernel
 from .prefilter import PrefilteredKernel
+from .reverse import ReverseQueryKernel, what_is_allowed_batch
 
 __all__ = [
     "StringInterner",
@@ -15,4 +16,6 @@ __all__ = [
     "encode_requests",
     "DecisionKernel",
     "PrefilteredKernel",
+    "ReverseQueryKernel",
+    "what_is_allowed_batch",
 ]

@@ -186,7 +186,13 @@ def encode_requests(
     requests: list[Request],
     compiled: CompiledPolicies,
     resource_adapter=None,
+    skip_conditions: bool = False,
 ) -> RequestBatch:
+    """``skip_conditions=True`` skips the host-assisted condition pre-pass
+    (and its adapter-driven batch degradation): whatIsAllowed never
+    evaluates conditions (the reverse query copies them verbatim into the
+    RQ tree, reference accessController.ts:383-400), so its encoder calls
+    must not pay for them."""
     urns = compiled.urns
     it = compiled.interner.intern
     B = len(requests)
@@ -521,7 +527,7 @@ def encode_requests(
     cond_true = np.zeros((C, B), bool)
     cond_abort = np.zeros((C, B), bool)
     cond_code = np.full((C, B), 200, np.int32)
-    for ci, cc in enumerate(compiled.conditions):
+    for ci, cc in enumerate([] if skip_conditions else compiled.conditions):
         has_query = cc.context_query is not None and (
             getattr(cc.context_query, "filters", None)
             or getattr(cc.context_query, "query", None)

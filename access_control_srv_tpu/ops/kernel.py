@@ -80,6 +80,25 @@ def pad_cols(a: np.ndarray, width: int) -> np.ndarray:
     return np.concatenate([a, fill], axis=1)
 
 
+def lead_padding(batch):
+    """Shared batch-axis padding contract for every kernel entry: returns
+    (b, bucket, e_bucket, pad_lead) where ``pad_lead`` zero-fills the
+    leading axis out to the power-of-two bucket.  Rows are independent
+    under vmap, so zero-padded rows cannot affect real rows."""
+    b = batch.arrays[next(iter(batch.arrays))].shape[0]
+    bucket = pow2_bucket(b)
+
+    def pad_lead(a: np.ndarray) -> np.ndarray:
+        a = np.asarray(a)
+        if a.shape[0] == bucket:
+            return a
+        fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
+        return np.concatenate([a, fill], axis=0)
+
+    e_bucket = pow2_bucket(batch.rgx_set.shape[1])
+    return b, bucket, e_bucket, pad_lead
+
+
 def _pairs_subset(rule_ids, rule_vals, req_ids, req_vals):
     """Every valid rule (id, value) pair appears among the request pairs
     (reference: attributesMatch, accessController.ts:681-699)."""
@@ -274,7 +293,8 @@ def _acl_pass(c: dict, r: dict, with_acl: bool):
     return skip | (short == 1) | ((short == 0) & pair_ok)
 
 
-def _match_targets(c: dict, r: dict, with_hr: bool = True):
+def _match_targets(c: dict, r: dict, with_hr: bool = True,
+                   wia: bool = False):
     """Stages A (target matching) + B (HR scopes) for one request: returns
     per-target-row match vectors the rule/policy stages gather from.
 
@@ -285,7 +305,16 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True):
     carries both subjects and a scoping entity (then ``hr_trivial`` is True
     for every row and hr_pass degenerates to all-ones); callers assert that
     tree property statically so XLA never materializes the owner-check
-    tensors."""
+    tensors.
+
+    ``wia=True`` additionally emits the whatIsAllowed-mode match vectors
+    (reference: accessController.ts:592-640 — PERMIT fails only when the
+    target has properties, the request has none and the entity matched;
+    DENY never property-fails; the isAllowed deny-skip is not applied) and
+    conservative ``maybe_mask_*`` bits (the row COULD append masking
+    obligations: target properties + an entity hit), which the host-side
+    reverse-query assembler (ops/reverse.py) uses to decide when the
+    scalar matcher must re-run for its side effects."""
     T = c["t_role"].shape[0]
 
     # ---------------------------------------------------------------- A: targets
@@ -400,15 +429,32 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True):
     tm_rg_p = base & res_rg_p
     tm_rg_d = base & res_rg_d
 
+    out = {
+        "tm_ex_p": tm_ex_p,
+        "tm_ex_d": tm_ex_d,
+        "tm_rg_p": tm_rg_p,
+        "tm_rg_d": tm_rg_d,
+    }
+    if wia:
+        # whatIsAllowed PERMIT property-fail: target props, request has no
+        # props, entity matched somewhere (ref :592-615 return branch)
+        wia_fail_ex = has_props & ~r_has_props & ent_any_ex
+        wia_fail_rg = has_props & ~r_has_props & state_any_rg
+        out["tm_wia_ex_p"] = base & (
+            no_res | ((ent_any_ex | opm) & ~wia_fail_ex)
+        )
+        out["tm_wia_ex_d"] = base & (no_res | ent_any_ex | opm)
+        out["tm_wia_rg_p"] = base & (
+            no_res | (state_final_rg & ~wia_fail_rg)
+        )
+        out["tm_wia_rg_d"] = base & (no_res | state_final_rg)
+        out["maybe_mask_ex"] = has_props & ent_any_ex
+        out["maybe_mask_rg"] = has_props & state_any_rg
+
     # ------------------------------------------------------------- B: HR scopes
     if not with_hr:
-        return {
-            "tm_ex_p": tm_ex_p,
-            "tm_ex_d": tm_ex_d,
-            "tm_rg_p": tm_rg_p,
-            "tm_rg_d": tm_rg_d,
-            "hr_pass": jnp.ones((T,), bool),
-        }
+        out["hr_pass"] = jnp.ones((T,), bool)
+        return out
     # collection per (target, entity slot, run) with sticky state like the
     # reference HR loop (exact OR regex sets, prefix mismatch resets,
     # reference: hierarchicalScope.ts:61-124)
@@ -503,13 +549,8 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True):
         & ~op_bad.any(axis=1)
     )
 
-    return {
-        "tm_ex_p": tm_ex_p,
-        "tm_ex_d": tm_ex_d,
-        "tm_rg_p": tm_rg_p,
-        "tm_rg_d": tm_rg_d,
-        "hr_pass": hr_pass,
-    }
+    out["hr_pass"] = hr_pass
+    return out
 
 
 def _rule_predicates(c: dict, r: dict, m: dict, with_acl: bool = True):
@@ -790,21 +831,9 @@ class DecisionKernel:
         The batch axis is padded to a power-of-two bucket before entering
         jit: without bucketing every distinct batch size is a fresh XLA
         compile, which would stall a micro-batched serving path on nearly
-        every call.  Rows are independent under vmap, so zero-padded rows
-        cannot affect real rows; their outputs are sliced away."""
-        b = batch.arrays[next(iter(batch.arrays))].shape[0]
-        bucket = pow2_bucket(b)
-
-        def pad_lead(a: np.ndarray) -> np.ndarray:
-            a = np.asarray(a)
-            if a.shape[0] == bucket:
-                return a
-            fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
-            return np.concatenate([a, fill], axis=0)
-
-        # distinct-entity count also varies per batch; bucket it too so the
-        # regex matrices keep a stable compiled shape
-        e_bucket = pow2_bucket(batch.rgx_set.shape[1])
+        every call (the distinct-entity axis of the regex matrices is
+        bucketed for the same reason)."""
+        b, bucket, e_bucket, pad_lead = lead_padding(batch)
 
         # dispatch on ACL content: only batches actually carrying ACL
         # pairs pay for the tensorized verifyACL create-scan (the no-pair

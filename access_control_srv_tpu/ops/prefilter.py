@@ -47,6 +47,7 @@ from .encode import RequestBatch
 from .kernel import (
     DecisionKernel,
     _evaluate_one,
+    lead_padding,
     pad_cols,
     pow2_bucket,
     tree_needs_hr,
@@ -294,15 +295,7 @@ class PrefilteredKernel:
             keys.append(key)
         stacked = self._stack(tuple(keys), subs)
 
-        bucket = pow2_bucket(B)
-
-        def pad_lead(a: np.ndarray) -> np.ndarray:
-            if a.shape[0] == bucket:
-                return a
-            fill = np.zeros((bucket - a.shape[0],) + a.shape[1:], a.dtype)
-            return np.concatenate([a, fill], axis=0)
-
-        e_bucket = pow2_bucket(rgx_np.shape[1])
+        _, bucket, e_bucket, pad_lead = lead_padding(batch)
         g_idx = pad_lead(inv.astype(np.int32).reshape(B))
         run = self._runner(
             bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),

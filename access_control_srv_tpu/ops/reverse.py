@@ -1,0 +1,233 @@
+"""Batched ``whatIsAllowed`` (reverse query) on device.
+
+The reverse query's cost in a batch setting is the repeated target match
+per (request x node): for every policy set, policy and rule the oracle
+walks the whole request attribute list (reference:
+accessController.ts:326-427 calling targetMatches :661-672).  The device
+already computes exactly those match vectors — ``_match_targets`` with
+``wia=True`` emits the whatIsAllowed-mode variants for every target row
+of the batch in one dispatch.
+
+whatIsAllowed does no HR-scope, ACL or condition work, so the only thing
+the device CANNOT reproduce is the obligation side effect: masking
+obligations accumulate during the scalar attribute scan, including from
+calls whose final verdict is False (reference :592-640).  The split is:
+
+- device: [B, T] wia match vectors + a conservative ``maybe_mask`` bit
+  (target has properties AND its entity matched: the precondition for any
+  mask append);
+- host: replay the oracle's exact control flow per request, substituting
+  device booleans for match results, and re-running the scalar matcher
+  ONLY on rows whose maybe_mask bit is set (for its obligation side
+  effects; its boolean agrees with the device by construction).
+
+Result: bit-identical ReverseQuery trees and obligations (differential:
+tests/test_reverse.py), with the scalar matcher invoked only on the small
+property-relevant subset instead of every (request x node)."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..models.model import PolicyRQ, PolicySetRQ, ReverseQuery, RuleRQ
+from ..models.model import OperationStatus
+from .compile import CompiledPolicies
+from .encode import RequestBatch, encode_requests
+from .kernel import _match_targets, lead_padding, pad_cols
+
+WIA_KEYS = [
+    "tm_wia_ex_p", "tm_wia_ex_d", "tm_wia_rg_p", "tm_wia_rg_d",
+    "maybe_mask_ex", "maybe_mask_rg",
+]
+
+
+class ReverseQueryKernel:
+    """One jitted dispatch computing the whatIsAllowed match vectors for
+    every (request, target row) of a batch.
+
+    ``policy_sets`` is deep-copied at construction: hot tree mutations
+    (engine.update_rule & co. mutate combinables dicts in place) must not
+    shift nodes under the compiled index arrays mid-serve — the reverse
+    query serves version-pinned from this snapshot, exactly like the
+    decision kernel serves from its compiled arrays."""
+
+    def __init__(self, compiled: CompiledPolicies, policy_sets):
+        if not compiled.supported:
+            raise ValueError(
+                f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        self.compiled = compiled
+        if isinstance(policy_sets, dict):
+            sets = [ps for ps in policy_sets.values() if ps is not None]
+        else:
+            sets = [ps for ps in policy_sets if ps is not None]
+        self.sets = copy.deepcopy(sets)
+        c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+
+        def run(batch_arrays, rgx_set, pfx_neq):
+            def one(ra, rs, pn):
+                rr = {**ra, "rgx_set": rs, "pfx_neq": pn}
+                m = _match_targets(c, rr, with_hr=False, wia=True)
+                return {k: m[k] for k in WIA_KEYS}
+
+            return jax.vmap(one, in_axes=({k: 0 for k in batch_arrays},
+                                          None, None))(
+                batch_arrays, rgx_set, pfx_neq
+            )
+
+        self._run = jax.jit(run)
+
+    def evaluate(self, batch: RequestBatch) -> dict[str, np.ndarray]:
+        """Returns {key: [B, T] bool} for the six wia vectors."""
+        import jax.numpy as jnp
+
+        b, _, e_bucket, pad_lead = lead_padding(batch)
+        out = self._run(
+            {k: jnp.asarray(pad_lead(v)) for k, v in batch.arrays.items()},
+            jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
+            jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
+        )
+        return {k: np.asarray(v)[:b] for k, v in out.items()}
+
+
+def _assemble(
+    engine, compiled: CompiledPolicies, sets, request, m
+) -> ReverseQuery:
+    """Replay of AccessController.what_is_allowed (engine.py:373-499,
+    reference accessController.ts:326-427) with device match vectors.
+
+    ``sets``: the kernel's version-pinned tree snapshot — MUST be the tree
+    the compiled index arrays were built from (live engine.policy_sets can
+    mutate under a concurrent hot update).
+    ``m``: {key: [T] bool} for this request.  Obligations are produced by
+    the scalar matcher re-run on maybe_mask rows — identical side-effect
+    order to the oracle because the control flow is identical."""
+    a = compiled.arrays
+    obligations = []
+    engine.prepare_context(request)
+    entity_urn = engine.urns.get("entity")
+
+    def tm(row: int, target_obj, effect, regex: bool) -> bool:
+        mode = "rg" if regex else "ex"
+        if m[f"maybe_mask_{mode}"][row]:
+            return engine._target_matches(
+                target_obj, request, "whatIsAllowed", obligations,
+                effect, regex,
+            )
+        deny = effect == "DENY"
+        return bool(m[f"tm_wia_{mode}_{'d' if deny else 'p'}"][row])
+
+    policy_sets_rq: list[PolicySetRQ] = []
+    for s, policy_set in enumerate(sets):
+        if policy_set.target is None or tm(
+            int(a["set_target"][s]), policy_set.target, None, False
+        ):
+            pset = PolicySetRQ(
+                id=policy_set.id,
+                target=policy_set.target,
+                combining_algorithm=policy_set.combining_algorithm,
+            )
+
+            exact_match = False
+            policy_effect = None
+            for kp, policy in enumerate(policy_set.combinables.values()):
+                if policy is None:
+                    continue
+                if policy.effect:
+                    policy_effect = policy.effect
+                if policy.target and tm(
+                    int(a["pol_target"][s, kp]), policy.target,
+                    policy_effect, False,
+                ):
+                    exact_match = True
+                    break
+
+            req_entity_count = len([
+                at for at in (request.target.resources or [])
+                if at and at.id == entity_urn
+            ])
+            if exact_match and req_entity_count > 1:
+                exact_match = engine._check_multiple_entities_match(
+                    policy_set, request, obligations
+                )
+
+            for kp, policy in enumerate(policy_set.combinables.values()):
+                if policy is None:
+                    continue
+                row = int(a["pol_target"][s, kp])
+                if (
+                    policy.target is None
+                    or (exact_match
+                        and tm(row, policy.target, policy_effect, False))
+                    or (not exact_match
+                        and tm(row, policy.target, policy_effect, True))
+                ):
+                    policy_rq = PolicyRQ(
+                        id=policy.id,
+                        target=policy.target,
+                        effect=policy.effect,
+                        evaluation_cacheable=policy.evaluation_cacheable,
+                        combining_algorithm=policy.combining_algorithm,
+                        has_rules=bool(policy.combinables),
+                    )
+                    for kr, rule in enumerate(policy.combinables.values()):
+                        if rule is None:
+                            continue
+                        rrow = int(a["rule_target"][s, kp, kr])
+                        matches = rule.target is None or tm(
+                            rrow, rule.target, rule.effect, False
+                        )
+                        if not matches:
+                            matches = tm(rrow, rule.target, rule.effect, True)
+                        if rule.target is None or matches:
+                            policy_rq.rules.append(RuleRQ(
+                                id=rule.id,
+                                target=rule.target,
+                                effect=rule.effect,
+                                condition=rule.condition,
+                                context_query=rule.context_query,
+                                evaluation_cacheable=rule.evaluation_cacheable,
+                            ))
+                    if policy_rq.effect or (
+                        not policy_rq.effect and policy_rq.rules
+                    ):
+                        pset.policies.append(policy_rq)
+
+            if pset.policies:
+                policy_sets_rq.append(pset)
+
+    return ReverseQuery(
+        policy_sets=policy_sets_rq,
+        obligations=obligations,
+        operation_status=OperationStatus(),
+    )
+
+
+def what_is_allowed_batch(
+    engine,
+    compiled: CompiledPolicies,
+    kernel: ReverseQueryKernel,
+    requests: list,
+    batch: RequestBatch | None = None,
+) -> list[ReverseQuery]:
+    """Batched reverse query: device match vectors + host assembly over
+    the kernel's version-pinned tree snapshot; ineligible rows fall back
+    to the scalar oracle wholesale."""
+    if batch is None:
+        batch = encode_requests(
+            requests, compiled, skip_conditions=True
+        )
+    masks = kernel.evaluate(batch)
+    out = []
+    for b, request in enumerate(requests):
+        if not batch.eligible[b]:
+            out.append(engine.what_is_allowed(request))
+            continue
+        m = {k: v[b] for k, v in masks.items()}
+        out.append(_assemble(engine, compiled, kernel.sets, request, m))
+    return out

@@ -43,6 +43,7 @@ class HybridEvaluator:
         self._version = 0
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
+        self._rq_kernel = None
         self._native_encoder = None
         self._lock = threading.Lock()
         self._compile_thread: Optional[threading.Thread] = None
@@ -72,6 +73,7 @@ class HybridEvaluator:
                 if version >= self._version:  # drop stale compiles
                     self._compiled = compiled
                     self._kernel = kernel
+                    self._rq_kernel = None  # lazy: built on first wia batch
                     self._native_encoder = native_encoder
             if self.logger and not compiled.supported:
                 self.logger.warning(
@@ -137,6 +139,49 @@ class HybridEvaluator:
 
     def what_is_allowed(self, request):
         return self.engine.what_is_allowed(request)
+
+    def what_is_allowed_batch(self, requests: list):
+        """Batched reverse query: target matching for the whole batch in
+        one device dispatch, tree/obligation assembly on host
+        (ops/reverse.py); scalar oracle when no kernel is active.  The
+        ReverseQueryKernel is built lazily on first use (deployments that
+        only serve isAllowed never pay its device transfer or the tree
+        snapshot copy)."""
+        with self._lock:
+            compiled = self._compiled
+            rq_kernel = self._rq_kernel
+        if (
+            self.backend == "oracle"
+            or compiled is None
+            or self._kernel is None
+        ):
+            self._count_path("oracle-wia", len(requests))
+            return [self.engine.what_is_allowed(r) for r in requests]
+        from ..ops.encode import encode_requests
+        from ..ops.reverse import ReverseQueryKernel, what_is_allowed_batch
+
+        if rq_kernel is None or rq_kernel.compiled.version != compiled.version:
+            with self._lock:
+                current = self._version
+            if compiled.version != current:
+                # the tree moved on since this compile; building a snapshot
+                # from the live tree would pair mismatched node indices --
+                # serve this call from the oracle, the pending refresh will
+                # swap in a consistent kernel
+                self._count_path("oracle-wia", len(requests))
+                return [self.engine.what_is_allowed(r) for r in requests]
+            rq_kernel = ReverseQueryKernel(compiled, self.engine.policy_sets)
+            with self._lock:
+                if self._compiled is compiled:
+                    self._rq_kernel = rq_kernel
+        batch = encode_requests(requests, compiled, skip_conditions=True)
+        out = what_is_allowed_batch(
+            self.engine, compiled, rq_kernel, requests, batch
+        )
+        n_oracle = int((~batch.eligible).sum())
+        self._count_path("oracle-wia", n_oracle)
+        self._count_path("kernel-wia", len(requests) - n_oracle)
+        return out
 
     def _count_path(self, path: str, rows: int) -> None:
         if self.telemetry is not None and rows:
