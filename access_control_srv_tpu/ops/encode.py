@@ -527,16 +527,25 @@ def encode_requests(
     cond_true = np.zeros((C, B), bool)
     cond_abort = np.zeros((C, B), bool)
     cond_code = np.full((C, B), 200, np.int32)
+    cand_cache: dict[tuple, np.ndarray] = {}
     for ci, cc in enumerate([] if skip_conditions else compiled.conditions):
         has_query = cc.context_query is not None and (
             getattr(cc.context_query, "filters", None)
             or getattr(cc.context_query, "query", None)
         )
         if has_query and resource_adapter is not None:
-            # adapter-driven context queries mutate request.context across
-            # rules; keep those on the oracle path
-            eligible[:] = False
-            break
+            # adapter-driven context queries pull resources inside the rule
+            # loop and mutate request.context for later rules (reference:
+            # accessController.ts:227-254), which the pre-pass cannot
+            # replay.  Fall back PER ROW: only rows this rule could reach
+            # (its target row is a match candidate for the row's resource
+            # signature — candidacy over-approximates the kernel's target
+            # match) leave the device; unreachable rows never pull, so
+            # their pre-pass results stay exact.
+            _mark_context_query_rows(
+                compiled, cc, a, eligible, rgx_set, cand_cache
+            )
+            continue
         for b, request in enumerate(requests):
             if not eligible[b]:
                 continue
@@ -558,6 +567,47 @@ def encode_requests(
         eligible=eligible,
         requests=requests,
     )
+
+
+def _mark_context_query_rows(
+    compiled, cc, a, eligible, rgx_set, cand_cache
+) -> None:
+    """Per-row oracle fallback for one adapter-backed context-query rule:
+    clears ``eligible`` for rows whose resource signature makes the rule's
+    target a match candidate (ops/prefilter.py candidacy — a sound
+    over-approximation of the kernel's target match, so every row kept on
+    device provably never reaches the rule)."""
+    from .prefilter import candidate_rows
+
+    KP, KR = compiled.KP, compiled.KR
+    s, rem = divmod(cc.rule_flat_index, KP * KR)
+    kp, kr = divmod(rem, KR)
+    if not bool(compiled.arrays["rule_has_target"][s, kp, kr]):
+        eligible[:] = False  # untargeted rule: reachable by every row
+        return
+    row = int(compiled.arrays["rule_target"][s, kp, kr])
+    for b in np.nonzero(eligible)[0]:
+        ents = a["r_ent_vals"][b]
+        cols = a["r_ent_e"][b]
+        valid = ents >= 0
+        ent_ids = np.unique(ents[valid])
+        ent_cols = np.array(
+            [cols[valid][ents[valid] == e][0] for e in ent_ids], np.int64
+        )
+        ops = a["r_op_vals"][b]
+        op_ids = np.unique(ops[ops >= 0])
+        acts = a["r_act_vals"][b]
+        act_vals = np.unique(acts[acts >= 0])
+        key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
+               tuple(act_vals.tolist()))
+        cand = cand_cache.get(key)
+        if cand is None:
+            cand = candidate_rows(
+                compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
+            )
+            cand_cache[key] = cand
+        if cand[row]:
+            eligible[b] = False
 
 
 def _encode_owners(

@@ -414,6 +414,94 @@ class TestAdapterContextQuery:
             w.stop()
 
 
+    def test_context_query_rule_degrades_only_candidate_rows(self):
+        """VERDICT r2 item 6: one adapter-backed context-query rule must
+        not push the whole batch to the oracle — only rows whose resource
+        signature can reach that rule fall back; the rest stay on device
+        with exact pre-pass results."""
+        import json
+
+        def transport(url, body, headers):
+            return json.dumps(
+                {
+                    "data": {
+                        "getAllAddresses": {
+                            "details": [{"payload": {"country_id": "DE"}}],
+                            "operation_status": {"code": 200, "message": "ok"},
+                        }
+                    }
+                }
+            ).encode()
+
+        w = Worker().start(
+            {
+                "policies": {"type": "local", "paths": []},
+                "adapter": {
+                    "graphql": {"url": "http://example/graphql",
+                                "transport": transport}
+                },
+            }
+        )
+        try:
+            from access_control_srv_tpu.core.loader import load_policy_sets
+            from access_control_srv_tpu.ops import encode_requests
+
+            doc = {
+                "policy_sets": [{
+                    "id": "ps_mix", "combining_algorithm": PO,
+                    "policies": [{
+                        "id": "p_mix", "combining_algorithm": PO,
+                        "rules": [
+                            {
+                                "id": "r_cq", "effect": "PERMIT",
+                                "target": {
+                                    "resources": [{"id": URNS["entity"],
+                                                   "value": ORG}],
+                                },
+                                "context_query": {
+                                    "query": "query { getAllAddresses }",
+                                    "filters": [],
+                                },
+                                "condition": (
+                                    "any(r.country_id == 'DE' "
+                                    "for r in context._queryResult)"
+                                ),
+                            },
+                            {
+                                "id": "r_plain", "effect": "PERMIT",
+                                "target": {
+                                    "resources": [{"id": URNS["entity"],
+                                                   "value": USER}],
+                                },
+                            },
+                        ],
+                    }],
+                }]
+            }
+            for ps in load_policy_sets(doc):
+                w.engine.update_policy_set(ps)
+            w.evaluator.refresh()
+
+            def req(entity):
+                return build_request(
+                    subject_id="ada", subject_role="member",
+                    resource_type=entity, resource_id="X", action_type=READ,
+                )
+
+            batch = encode_requests(
+                [req(ORG), req(USER)], w.evaluator._compiled,
+                w.engine.resource_adapter,
+            )
+            assert not batch.eligible[0]  # cq rule candidate: oracle row
+            assert batch.eligible[1]      # plain row stays on device
+
+            responses = w.evaluator.is_allowed_batch([req(ORG), req(USER)])
+            assert responses[0].decision == Decision.PERMIT  # via adapter
+            assert responses[1].decision == Decision.PERMIT  # via kernel
+        finally:
+            w.stop()
+
+
 class TestConcurrentMutationServing:
     """Policy mutation must never disturb in-flight serving: the tree swap
     is atomic, so every concurrent decision is either old-tree or new-tree
