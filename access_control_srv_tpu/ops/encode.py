@@ -37,7 +37,13 @@ from ..models.model import Request
 from .compile import CompiledPolicies
 from .interner import ABSENT
 
-# per-request padding caps
+# per-request padding caps: FLOORS of the adaptive scheme.  Each batch is
+# pre-scanned and every dimension is bucketed to the next power of two of
+# the batch maximum (floor = these defaults, hard ceiling = _CAPS_CEIL),
+# so deep-HR / wide-ACL traffic stays kernel-eligible instead of falling
+# to the scalar oracle, while common traffic keeps one compiled shape.
+# The native (C++) wire encoder keeps the floor shapes; its over-cap rows
+# fall back to the Python path's adaptive encoding via eligibility.
 NR = 4      # entity runs
 NI = 4      # resource instances
 NP = 8      # property attributes
@@ -51,6 +57,123 @@ NROLE = 4   # subject roles
 NACLE = 4   # distinct ACL scoping entities per request
 NACLI = 8   # ACL instances per scoping entity
 NHRR = 8    # distinct HR-tree roles (verifyACL flatten) per request
+
+_CAPS_FLOOR = {
+    "NR": NR, "NI": NI, "NP": NP, "NSUB": NSUB, "NACT": NACT, "NOP": NOP,
+    "NOWN": NOWN, "NRA": NRA, "NHR": NHR, "NROLE": NROLE, "NACLE": NACLE,
+    "NACLI": NACLI, "NHRR": NHRR,
+}
+_CAPS_CEIL = {
+    "NR": 16, "NI": 32, "NP": 64, "NSUB": 32, "NACT": 16, "NOP": 8,
+    "NOWN": 32, "NRA": 128, "NHR": 1024, "NROLE": 16, "NACLE": 16,
+    "NACLI": 64, "NHRR": 32,
+}
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+def compute_caps(requests, urns) -> dict[str, int]:
+    """Pre-scan the batch and bucket every padding dimension to the next
+    power of two above the batch maximum (floor = module defaults, hard
+    ceiling = _CAPS_CEIL).  Estimates only need to be upper bounds per
+    dimension — the fill loop still marks genuinely over-cap rows
+    ineligible, so an under-estimate degrades to oracle fallback, never to
+    a wrong decision."""
+    entity_urn = urns.get("entity")
+    property_urn = urns.get("property")
+    operation_urn = urns.get("operation")
+    resource_id_urn = urns.get("resourceID")
+    scoping_urn = urns.get("roleScopingEntity")
+    scoping_inst_urn = urns.get("roleScopingInstance")
+    owner_ent_urn = urns.get("ownerEntity")
+    owner_inst_urn = urns.get("ownerInstance")
+    acl_ind_urn = urns.get("aclIndicatoryEntity")
+
+    need = dict.fromkeys(_CAPS_FLOOR, 0)
+
+    def bump(key, val):
+        if val > need[key]:
+            need[key] = val
+
+    for request in requests:
+        target = request.target
+        if not target:
+            continue
+        bump("NSUB", len(target.subjects or []))
+        bump("NACT", len(target.actions or []))
+        runs = props = ops = insts = 0
+        seen_run = False
+        for attr in target.resources or []:
+            if attr.id == entity_urn:
+                runs += 1
+                seen_run = True
+            elif attr.id == property_urn:
+                props += 1
+            elif attr.id == operation_urn:
+                ops += 1
+            elif attr.id == resource_id_urn and seen_run:
+                insts += 1
+        bump("NR", runs)
+        bump("NP", props)
+        bump("NOP", ops)
+        bump("NI", insts)
+
+        context = request.context
+        subject = get_field(context, "subject") or {} if context else {}
+        role_assocs = get_field(subject, "role_associations") or []
+        roles, ra3, ra2 = set(), 0, set()
+        for ra in role_assocs:
+            role = get_field(ra, "role")
+            if role is not None:
+                roles.add(role)
+            for ra_attr in get_field(ra, "attributes") or []:
+                if get_field(ra_attr, "id") != scoping_urn:
+                    continue
+                ent = get_field(ra_attr, "value")
+                ra2.add((role, ent))
+                for inst in get_field(ra_attr, "attributes") or []:
+                    if get_field(inst, "id") == scoping_inst_urn:
+                        ra3 += 1
+        bump("NROLE", len(roles))
+        bump("NRA", max(ra3, len(ra2)))
+
+        scopes = get_field(subject, "hierarchical_scopes")
+        hr_pairs: list = []
+        _flatten_hr(scopes, hr_pairs)
+        bump("NHR", len(set(hr_pairs)))
+        acl_hr: list = []
+        _flatten_acl_hr(scopes, acl_hr)
+        bump("NHR", len(set(acl_hr)))
+        bump("NHRR", len({r for r, _ in acl_hr if r is not None}))
+
+        acl_ents, acl_insts_total, own_max = set(), 0, 0
+        for res in (get_field(context, "resources") or []) if context else []:
+            meta = get_field(res, "meta")
+            for acl in (get_field(meta, "acls") or []) if meta else []:
+                if get_field(acl, "id") == acl_ind_urn:
+                    acl_ents.add(get_field(acl, "value"))
+                    acl_insts_total += len(get_field(acl, "attributes") or [])
+            own = 0
+            for owner in (get_field(meta, "owners") or []) if meta else []:
+                if get_field(owner, "id") != owner_ent_urn:
+                    continue
+                own += sum(
+                    1 for i in (get_field(owner, "attributes") or [])
+                    if get_field(i, "id") == owner_inst_urn
+                )
+            own_max = max(own_max, own)
+        bump("NACLE", len(acl_ents))
+        bump("NACLI", acl_insts_total)
+        bump("NOWN", own_max)
+
+    return {
+        key: min(_CAPS_CEIL[key], _pow2_at_least(need[key], _CAPS_FLOOR[key]))
+        for key in _CAPS_FLOOR
+    }
 
 
 def urn_tail(value: str) -> str:
@@ -74,6 +197,8 @@ class RequestBatch:
     cond_code: np.ndarray
     eligible: np.ndarray
     requests: list[Request] = field(default_factory=list)
+    # per-reason counts for rows that fell back to the scalar oracle
+    ineligible_reasons: dict[str, int] = field(default_factory=dict)
 
 
 class _RegexCache:
@@ -129,10 +254,23 @@ def _flatten_acl_hr(nodes, out: list, role=None):
             _flatten_acl_hr(children, out, key)
 
 
-def alloc_row_arrays(B: int) -> dict[str, np.ndarray]:
-    """The per-request kernel row arrays; shared by the Python encoder and
-    the native (C++) wire encoder, which fills the same buffers in place
+def alloc_row_arrays(B: int, caps: dict[str, int] | None = None
+                     ) -> dict[str, np.ndarray]:
+    """The per-request kernel row arrays; shared by the Python encoder
+    (adaptive ``caps`` from compute_caps) and the native (C++) wire
+    encoder, which fills the same buffers in place at the FLOOR shapes
     (the ctypes pointer order lives in native/__init__._ARRAY_ORDER)."""
+    if caps is not None:
+        NR = caps["NR"]; NI = caps["NI"]; NP = caps["NP"]
+        NSUB = caps["NSUB"]; NACT = caps["NACT"]; NOP = caps["NOP"]
+        NOWN = caps["NOWN"]; NRA = caps["NRA"]; NHR = caps["NHR"]
+        NROLE = caps["NROLE"]; NACLE = caps["NACLE"]
+        NACLI = caps["NACLI"]; NHRR = caps["NHRR"]
+    else:
+        NR, NI, NP, NSUB, NACT, NOP, NOWN, NRA, NHR, NROLE, NACLE, NACLI, \
+            NHRR = (_CAPS_FLOOR[k] for k in (
+                "NR", "NI", "NP", "NSUB", "NACT", "NOP", "NOWN", "NRA",
+                "NHR", "NROLE", "NACLE", "NACLI", "NHRR"))
     return {
         "r_sub_ids": np.full((B, NSUB), ABSENT, np.int32),
         "r_sub_vals": np.full((B, NSUB), ABSENT, np.int32),
@@ -187,16 +325,30 @@ def encode_requests(
     compiled: CompiledPolicies,
     resource_adapter=None,
     skip_conditions: bool = False,
+    caps: dict[str, int] | None = None,
 ) -> RequestBatch:
     """``skip_conditions=True`` skips the host-assisted condition pre-pass
     (and its adapter-driven batch degradation): whatIsAllowed never
     evaluates conditions (the reverse query copies them verbatim into the
     RQ tree, reference accessController.ts:383-400), so its encoder calls
-    must not pay for them."""
+    must not pay for them.
+
+    ``caps`` overrides the adaptive per-batch padding caps (the native
+    wire encoder's fixed floor shapes use this for parity testing)."""
     urns = compiled.urns
     it = compiled.interner.intern
     B = len(requests)
     W = max(len(compiled.entity_vocab), 1)
+
+    # adaptive per-batch padding caps (shadow the module floors; every
+    # reference below uses the batch-bucketed values)
+    if caps is None:
+        caps = compute_caps(requests, urns)
+    NR = caps["NR"]; NI = caps["NI"]; NP = caps["NP"]
+    NSUB = caps["NSUB"]; NACT = caps["NACT"]; NOP = caps["NOP"]
+    NOWN = caps["NOWN"]; NRA = caps["NRA"]; NHR = caps["NHR"]
+    NROLE = caps["NROLE"]; NACLE = caps["NACLE"]; NACLI = caps["NACLI"]
+    NHRR = caps["NHRR"]
 
     entity_urn = urns.get("entity")
     property_urn = urns.get("property")
@@ -228,29 +380,31 @@ def encode_requests(
             batch_entity_values.append(value)
         return idx
 
-    a = alloc_row_arrays(B)
+    a = alloc_row_arrays(B, caps)
     eligible = np.ones((B,), bool)
+    ineligible_reasons: dict[str, int] = {}
 
-    def mark(b, reason=None):
+    def mark(b, reason="other"):
         eligible[b] = False
+        ineligible_reasons[reason] = ineligible_reasons.get(reason, 0) + 1
 
     for b, request in enumerate(requests):
         target = request.target
         if not target:
-            mark(b)  # no-target requests are a host-side 400 DENY
+            mark(b, "no-target")  # host-side 400 DENY
             continue
         a["r_has_target"][b] = True
         context = request.context
         subject = get_field(context, "subject") or {}
         if get_field(subject, "token"):
-            mark(b)
+            mark(b, "token-subject")
             continue
 
         # ---- subject / roles / actions
         subs = target.subjects or []
         acts = target.actions or []
         if len(subs) > NSUB or len(acts) > NACT:
-            mark(b)
+            mark(b, "subject-action-cap")
             continue
         for j, attr in enumerate(subs):
             a["r_sub_ids"][b, j] = it(attr.id)
@@ -266,7 +420,7 @@ def encode_requests(
             if role is not None and role not in roles:
                 roles.append(role)
         if len(roles) > NROLE:
-            mark(b)
+            mark(b, "role-cap")
             continue
         for j, role in enumerate(roles):
             a["r_roles"][b, j] = it(role)
@@ -297,13 +451,13 @@ def encode_requests(
                 ok = False  # unknown resource attribute id
                 break
         if not ok or len(runs) > NR or len(props) > NP or len(ops) > NOP:
-            mark(b)
+            mark(b, "resource-shape")
             continue
         if sum(len(r["instances"]) for r in runs) > NI:
-            mark(b)
+            mark(b, "instance-cap")
             continue
         if tails_ambiguous and props:
-            mark(b)
+            mark(b, "ambiguous-entity-tails")
             continue
         # verify substring relevance == tail equality for every
         # (vocab entity, request property) pair
@@ -321,7 +475,7 @@ def encode_requests(
                 relevance_broken = True
                 break
         if relevance_broken:
-            mark(b)
+            mark(b, "property-relevance")
             continue
 
         ctx_resources = get_field(context, "resources") or [] if context else []
@@ -381,7 +535,7 @@ def encode_requests(
             len(acl_ents) > NACLE
             or any(len(insts) > NACLI for insts in acl_insts)
         ):
-            mark(b)  # ACL shape beyond caps: oracle fallback
+            mark(b, "acl-cap")  # oracle fallback
             continue
         if acl_short == 0 and (
             any(e < 0 for e in acl_ents)
@@ -391,7 +545,7 @@ def encode_requests(
             # the kernel's validity masks would silently drop it and pass
             # where the reference fails closed (verifyACL.ts keys its map on
             # undefined) -- fall back to the oracle instead
-            mark(b)
+            mark(b, "acl-absent-value")
             continue
         a["r_acl_short"][b] = acl_short
         if acl_short == 0:
@@ -422,7 +576,8 @@ def encode_requests(
                     a["r_inst_has_owners"][b, inst_slot] = len(owners) > 0
                     if not _encode_owners(
                         a["r_inst_owner_ent"], a["r_inst_owner_inst"],
-                        (b, inst_slot), owners, owner_ent_urn, owner_inst_urn, it,
+                        (b, inst_slot), owners, owner_ent_urn,
+                        owner_inst_urn, it, NOWN,
                     ):
                         overflow = True
                 inst_slot += 1
@@ -446,7 +601,7 @@ def encode_requests(
                 a["r_op_has_owners"][b, j] = len(owners) > 0
                 if not _encode_owners(
                     a["r_op_owner_ent"], a["r_op_owner_inst"],
-                    (b, j), owners, owner_ent_urn, owner_inst_urn, it,
+                    (b, j), owners, owner_ent_urn, owner_inst_urn, it, NOWN,
                 ):
                     overflow = True
 
@@ -470,7 +625,7 @@ def encode_requests(
             # InvalidRequestContext for a missing scope list (the reference
             # throws in both verifyACL and the HR phase); keep such
             # requests on the oracle path
-            mark(b)
+            mark(b, "missing-hr-scopes")
             continue
         hr_pairs: list[tuple[Optional[str], str]] = []
         _flatten_hr(hierarchical_scopes, hr_pairs)
@@ -498,7 +653,7 @@ def encode_requests(
             len(ra3) > NRA or len(ra2) > NRA or len(hr_enc) > NHR
             or len(acl_hr_enc) > NHR or len(hr_roles) > NHRR or overflow
         ):
-            mark(b)
+            mark(b, "hr-cap")
             continue
         for j, t3 in enumerate(ra3):
             a["r_ra3"][b, j] = t3
@@ -543,7 +698,7 @@ def encode_requests(
             # match) leave the device; unreachable rows never pull, so
             # their pre-pass results stay exact.
             _mark_context_query_rows(
-                compiled, cc, a, eligible, rgx_set, cand_cache
+                compiled, cc, a, eligible, mark, rgx_set, cand_cache
             )
             continue
         for b, request in enumerate(requests):
@@ -566,11 +721,12 @@ def encode_requests(
         cond_code=cond_code,
         eligible=eligible,
         requests=requests,
+        ineligible_reasons=ineligible_reasons,
     )
 
 
 def _mark_context_query_rows(
-    compiled, cc, a, eligible, rgx_set, cand_cache
+    compiled, cc, a, eligible, mark, rgx_set, cand_cache
 ) -> None:
     """Per-row oracle fallback for one adapter-backed context-query rule:
     clears ``eligible`` for rows whose resource signature makes the rule's
@@ -583,7 +739,8 @@ def _mark_context_query_rows(
     s, rem = divmod(cc.rule_flat_index, KP * KR)
     kp, kr = divmod(rem, KR)
     if not bool(compiled.arrays["rule_has_target"][s, kp, kr]):
-        eligible[:] = False  # untargeted rule: reachable by every row
+        for b in np.nonzero(eligible)[0]:
+            mark(b, "context-query")  # untargeted rule: reachable everywhere
         return
     row = int(compiled.arrays["rule_target"][s, kp, kr])
     for b in np.nonzero(eligible)[0]:
@@ -607,11 +764,12 @@ def _mark_context_query_rows(
             )
             cand_cache[key] = cand
         if cand[row]:
-            eligible[b] = False
+            mark(b, "context-query")
 
 
 def _encode_owners(
-    ent_out, inst_out, index, owners, owner_ent_urn, owner_inst_urn, it
+    ent_out, inst_out, index, owners, owner_ent_urn, owner_inst_urn, it,
+    nown=NOWN,
 ) -> bool:
     """Flatten owner entries into (owner-entity-value, owner-instance)
     pairs; only well-formed entries participate in matching."""
@@ -622,7 +780,7 @@ def _encode_owners(
         val = it(get_field(owner, "value"))
         for inst_attr in get_field(owner, "attributes") or []:
             if get_field(inst_attr, "id") == owner_inst_urn:
-                if slot >= NOWN:
+                if slot >= nown:
                     return False
                 ent_out[index + (slot,)] = val
                 inst_out[index + (slot,)] = it(get_field(inst_attr, "value"))

@@ -410,6 +410,7 @@ def bench_hr_deep():
         "decisions/s",
         {"batch": base, "eligible": n_eligible,
          "eligible_pct": round(100.0 * n_eligible / base, 1),
+         "ineligible_reasons": batch.ineligible_reasons,
          "mean_tree_nodes": round(float(np.mean(node_counts)), 1),
          "max_tree_nodes": int(np.max(node_counts))},
     )
