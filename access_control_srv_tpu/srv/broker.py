@@ -1,0 +1,325 @@
+"""Cross-process event/cache backend over TCP.
+
+The in-process ``EventBus``/``SubjectCache`` (srv/events.py, srv/cache.py)
+implement the reference's Kafka-topic and Redis-cache ROLES inside one
+process.  This module provides the inter-process implementation behind the
+same interfaces: a small broker process holds the topic logs (offsets,
+replay) and the shared key-value store; workers connect over TCP with
+newline-delimited JSON frames.
+
+Mirrors the reference deployment shape (Kafka broker + Redis server as
+separate processes, cfg/config.json events.kafka / redis): the HR-scope
+rendezvous — request emitted by one process, response produced by another
+(reference: src/core/accessController.ts:753-767, src/worker.ts:252-299)
+— runs across OS processes (tests/test_broker.py drives it with a real
+child process).
+
+Protocol (one JSON object per line):
+  {"op": "emit", "topic": t, "event": e, "message": m} -> {"offset": n}
+  {"op": "read", "topic": t, "from": n}                -> {"events": [...]}
+  {"op": "offset", "topic": t}                          -> {"offset": n}
+  {"op": "subscribe", "topic": t, "from": n|null}       -> stream of
+      {"topic": t, "event": e, "message": m, "offset": n}   (replay + live)
+  {"op": "set"/"get"/"exists"/"evict_prefix", ...}      -> cache ops
+  {"op": "offset_commit"/"offset_get", ...}             -> consumer offsets
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import socketserver
+import threading
+from typing import Any, Callable, Optional
+
+
+def _send(wfile, obj: dict) -> None:
+    wfile.write(json.dumps(obj).encode() + b"\n")
+    wfile.flush()
+
+
+class BrokerServer:
+    """Topic logs + shared KV + consumer offsets behind one TCP port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._topics: dict[str, list[tuple[str, Any]]] = {}
+        self._kv: dict[str, Any] = {}
+        self._consumer_offsets: dict[str, int] = {}
+        self._subscribers: dict[str, list[queue.Queue]] = {}
+        self._lock = threading.Lock()
+        broker = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        cmd = json.loads(line)
+                    except ValueError:
+                        _send(self.wfile, {"error": "bad frame"})
+                        continue
+                    if cmd.get("op") == "subscribe":
+                        broker._serve_subscription(self, cmd)
+                        return  # connection now belongs to the stream
+                    try:
+                        _send(self.wfile, broker._dispatch(cmd))
+                    except (BrokenPipeError, ConnectionResetError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.address = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "BrokerServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, cmd: dict) -> dict:
+        op = cmd.get("op")
+        if op == "emit":
+            topic, event = cmd["topic"], cmd["event"]
+            message = cmd.get("message")
+            with self._lock:
+                log = self._topics.setdefault(topic, [])
+                log.append((event, message))
+                offset = len(log) - 1
+                subs = list(self._subscribers.get(topic, []))
+            frame = {"topic": topic, "event": event,
+                     "message": message, "offset": offset}
+            for q in subs:
+                q.put(frame)
+            return {"offset": offset}
+        if op == "read":
+            with self._lock:
+                log = list(self._topics.get(cmd["topic"], []))
+            start = cmd.get("from") or 0
+            return {"events": [[e, m] for e, m in log[start:]]}
+        if op == "offset":
+            with self._lock:
+                return {"offset": len(self._topics.get(cmd["topic"], []))}
+        if op == "set":
+            with self._lock:
+                self._kv[cmd["key"]] = cmd.get("value")
+            return {"ok": True}
+        if op == "get":
+            with self._lock:
+                return {"value": self._kv.get(cmd["key"]),
+                        "exists": cmd["key"] in self._kv}
+        if op == "exists":
+            with self._lock:
+                return {"exists": cmd["key"] in self._kv}
+        if op == "evict_prefix":
+            with self._lock:
+                keys = [k for k in self._kv if k.startswith(cmd["prefix"])]
+                for k in keys:
+                    del self._kv[k]
+            return {"evicted": len(keys)}
+        if op == "offset_commit":
+            with self._lock:
+                self._consumer_offsets[cmd["topic"]] = cmd["offset"]
+            return {"ok": True}
+        if op == "offset_get":
+            with self._lock:
+                return {"offset": self._consumer_offsets.get(cmd["topic"])}
+        return {"error": f"unknown op {op!r}"}
+
+    def _serve_subscription(self, handler, cmd: dict) -> None:
+        """Replay from the requested offset, then stream live frames until
+        the client disconnects."""
+        topic = cmd["topic"]
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            log = list(self._topics.get(topic, []))
+            self._subscribers.setdefault(topic, []).append(q)
+        try:
+            start = cmd.get("from")
+            if start is not None:
+                for offset, (event, message) in list(enumerate(log))[start:]:
+                    _send(handler.wfile, {"topic": topic, "event": event,
+                                          "message": message,
+                                          "offset": offset})
+            # live frames for offsets not covered by the replay
+            replayed_to = len(log)
+            while True:
+                frame = q.get()
+                if frame["offset"] < replayed_to and start is not None:
+                    continue  # raced with the replay window
+                _send(handler.wfile, frame)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            with self._lock:
+                subs = self._subscribers.get(topic, [])
+                if q in subs:
+                    subs.remove(q)
+
+
+class _Rpc:
+    """One request/response connection, serialized by a lock."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=30)
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+        self._lock = threading.Lock()
+
+    def call(self, obj: dict) -> dict:
+        with self._lock:
+            _send(self._wfile, obj)
+            line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("broker connection closed")
+        return json.loads(line)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketTopic:
+    """Topic interface (srv/events.py) backed by the broker."""
+
+    def __init__(self, name: str, address: str, rpc: _Rpc):
+        self.name = name
+        self._address = address
+        self._rpc = rpc
+        self._streams: list[socket.socket] = []
+
+    @property
+    def offset(self) -> int:
+        return self._rpc.call({"op": "offset", "topic": self.name})["offset"]
+
+    def emit(self, event_name: str, message: Any) -> int:
+        return self._rpc.call(
+            {"op": "emit", "topic": self.name,
+             "event": event_name, "message": message}
+        )["offset"]
+
+    def on(
+        self,
+        listener: Callable[[str, Any, dict], None],
+        starting_offset: Optional[int] = None,
+    ) -> None:
+        """Each listener gets its own streaming connection (replay from
+        ``starting_offset``, then live), dispatched from a daemon thread —
+        the Kafka-consumer analog of the in-process synchronous fanout."""
+        host, port = self._address.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)))
+        wfile = sock.makefile("wb")
+        rfile = sock.makefile("rb")
+        _send(wfile, {"op": "subscribe", "topic": self.name,
+                      "from": starting_offset})
+        self._streams.append(sock)
+
+        def pump():
+            try:
+                for line in rfile:
+                    frame = json.loads(line)
+                    listener(
+                        frame["event"], frame["message"],
+                        {"offset": frame["offset"], "topic": self.name},
+                    )
+            except (OSError, ValueError):
+                pass
+
+        threading.Thread(target=pump, daemon=True).start()
+
+    def read(self, from_offset: int = 0) -> list[tuple[str, Any]]:
+        events = self._rpc.call(
+            {"op": "read", "topic": self.name, "from": from_offset}
+        )["events"]
+        return [(e, m) for e, m in events]
+
+    def close(self) -> None:
+        for sock in self._streams:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class SocketEventBus:
+    """EventBus interface (srv/events.py) backed by a broker process."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._rpc = _Rpc(address)
+        self._topics: dict[str, SocketTopic] = {}
+        self._lock = threading.Lock()
+
+    def topic(self, name: str) -> SocketTopic:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = SocketTopic(name, self.address, self._rpc)
+            return self._topics[name]
+
+    def topics(self) -> dict[str, SocketTopic]:
+        return dict(self._topics)
+
+    def close(self) -> None:
+        for topic in self._topics.values():
+            topic.close()
+        self._rpc.close()
+
+
+class SocketSubjectCache:
+    """SubjectCache interface (srv/cache.py) backed by the broker KV —
+    the shared-Redis role: every worker process sees the same subject /
+    HR-scope entries."""
+
+    def __init__(self, address: str):
+        self._rpc = _Rpc(address)
+
+    def get(self, key: str) -> Any:
+        return self._rpc.call({"op": "get", "key": key})["value"]
+
+    def set(self, key: str, value: Any) -> None:
+        self._rpc.call({"op": "set", "key": key, "value": value})
+
+    def exists(self, key: str) -> bool:
+        return self._rpc.call({"op": "exists", "key": key})["exists"]
+
+    def evict_prefix(self, prefix: str) -> int:
+        return self._rpc.call(
+            {"op": "evict_prefix", "prefix": prefix}
+        )["evicted"]
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+class SocketOffsetStore:
+    """OffsetStore interface (srv/events.py) on the broker (the chassis
+    Redis DB-0 role)."""
+
+    def __init__(self, address: str):
+        self._rpc = _Rpc(address)
+
+    def commit(self, topic: str, offset: int) -> None:
+        self._rpc.call(
+            {"op": "offset_commit", "topic": topic, "offset": offset}
+        )
+
+    def get(self, topic: str) -> Optional[int]:
+        return self._rpc.call({"op": "offset_get", "topic": topic})["offset"]
+
+    def close(self) -> None:
+        self._rpc.close()

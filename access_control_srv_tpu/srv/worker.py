@@ -66,12 +66,25 @@ class Worker:
         self.logger = logger or make_logger()
         self.telemetry = Telemetry()
 
-        # event bus + offsets (Kafka + OffsetStore analog)
-        self.bus = EventBus()
-        self.offset_store = OffsetStore()
+        # event bus + offsets + subject cache: in-process by default;
+        # a configured broker address switches all three to the
+        # cross-process TCP backend (srv/broker.py — the reference's
+        # separate Kafka + Redis processes, cfg events.kafka / redis)
+        broker_address = cfg.get("events:broker:address")
+        if broker_address:
+            from .broker import (
+                SocketEventBus,
+                SocketOffsetStore,
+                SocketSubjectCache,
+            )
 
-        # subject cache + HR-scope rendezvous (Redis + Kafka protocol analog)
-        self.subject_cache = SubjectCache()
+            self.bus = SocketEventBus(broker_address)
+            self.offset_store = SocketOffsetStore(broker_address)
+            self.subject_cache = SocketSubjectCache(broker_address)
+        else:
+            self.bus = EventBus()
+            self.offset_store = OffsetStore()
+            self.subject_cache = SubjectCache()
         auth_topic = self.bus.topic("io.restorecommerce.authentication")
         self.hr_provider = HRScopeProvider(
             self.subject_cache,
@@ -180,6 +193,12 @@ class Worker:
     def stop(self) -> None:
         if self.batcher is not None:
             self.batcher.stop()
+        for attr in ("bus", "offset_store", "subject_cache"):
+            backend = getattr(self, attr, None)
+            if backend is not None and hasattr(backend, "close"):
+                backend.close()
+        if hasattr(self.identity_client, "close"):
+            self.identity_client.close()
 
     # -------------------------------------------------------- event handlers
 
