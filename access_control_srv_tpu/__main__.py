@@ -1,0 +1,86 @@
+"""Process entrypoint: start the worker + gRPC transport, stop cleanly on
+SIGINT/SIGTERM (reference: src/start.ts:6-21 — cfg from the working
+directory, worker.start, SIGINT -> worker.stop).
+
+    python -m access_control_srv_tpu [--config-dir DIR] [--addr HOST:PORT]
+    python -m access_control_srv_tpu --broker [--addr HOST:PORT]
+
+``--broker`` serves the cross-process event/cache broker (srv/broker.py)
+instead of a worker — the Kafka/Redis-role process of a multi-worker
+deployment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="access_control_srv_tpu")
+    parser.add_argument(
+        "--config-dir", default=os.getcwd(),
+        help="directory holding config.json / config_{ENV}.json",
+    )
+    parser.add_argument(
+        "--env", default=os.environ.get("NODE_ENV"),
+        help="config environment overlay name",
+    )
+    parser.add_argument(
+        "--addr", default=None,
+        help="bind address (overrides server:transports[0].addr)",
+    )
+    parser.add_argument(
+        "--broker", action="store_true",
+        help="serve the cross-process event/cache broker instead of a worker",
+    )
+    args = parser.parse_args(argv)
+
+    stop_event = threading.Event()
+
+    def request_stop(signum, frame):
+        stop_event.set()
+
+    signal.signal(signal.SIGINT, request_stop)
+    signal.signal(signal.SIGTERM, request_stop)
+
+    if args.broker:
+        from .srv.broker import BrokerServer
+
+        host, _, port = (args.addr or "127.0.0.1:0").rpartition(":")
+        broker = BrokerServer(host or "127.0.0.1", int(port)).start()
+        print(f"broker listening on {broker.address}", flush=True)
+        stop_event.wait()
+        broker.stop()
+        return 0
+
+    from .srv.config import Config
+    from .srv.transport_grpc import GrpcServer
+    from .srv.worker import Worker
+
+    cfg = Config.load(args.config_dir, env=args.env)
+    worker = Worker()
+    try:
+        worker.start(cfg)
+    except Exception as err:  # startup error path (start.ts:11-14)
+        print(f"startup error: {err}", file=sys.stderr, flush=True)
+        return 1
+    transports = cfg.get("server:transports") or []
+    addr = args.addr or (
+        transports[0].get("addr") if transports else "0.0.0.0:50061"
+    )
+    server = GrpcServer(worker, addr).start()
+    print(f"serving on {server.addr}", flush=True)
+
+    stop_event.wait()  # SIGINT / SIGTERM
+    print("shutting down", flush=True)
+    server.stop()
+    worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ class CommandInterface:
             "flush_cache": self.flush_cache,
             "set_api_key": self.set_api_key,
             "metrics": self.metrics,
+            "profile": self.profile,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -128,6 +129,36 @@ class CommandInterface:
         if telemetry is None:
             return {"error": "telemetry not wired"}
         return telemetry.snapshot()
+
+    def profile(self, payload: dict) -> dict:
+        """JAX profiler control (SURVEY section 5 tracing substitute): an
+        operator starts/stops a device trace at runtime to see where the
+        microseconds go — {"action": "start"|"stop", "dir": path}.
+        Traces open in TensorBoard / Perfetto; the XLA dump counterpart is
+        the profiling:xla_dump_dir config flag (worker startup)."""
+        action = (payload or {}).get("action")
+        if action == "start":
+            import jax
+
+            log_dir = (payload or {}).get("dir") or "/tmp/acs-tpu-trace"
+            try:
+                jax.profiler.start_trace(log_dir)
+            except Exception as err:
+                return {"error": f"trace start failed: {err}"}
+            self._trace_dir = log_dir
+            return {"status": "tracing", "dir": log_dir}
+        if action == "stop":
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception as err:
+                return {"error": f"trace stop failed: {err}"}
+            out = {"status": "stopped",
+                   "dir": getattr(self, "_trace_dir", None)}
+            self._trace_dir = None
+            return out
+        return {"error": f"unknown profile action {action!r}"}
 
     def set_api_key(self, payload: dict) -> dict:
         self.api_key = (payload or {}).get("authentication", {}).get("apiKey") or (

@@ -16,6 +16,7 @@ from __future__ import annotations
 import copy
 import json
 import os
+import re
 import threading
 import uuid
 from typing import Callable, Optional
@@ -90,6 +91,87 @@ class Collection:
         with self._lock:
             self._docs.clear()
             self._snapshot()
+
+
+class _FilterError(ValueError):
+    pass
+
+
+def _field_value(doc, path: str):
+    """Dotted-path lookup into the document (missing -> None)."""
+    node = doc
+    for part in path.split("."):
+        if isinstance(node, dict):
+            node = node.get(part)
+        else:
+            return None
+    return node
+
+
+def _coerce_json(raw):
+    if isinstance(raw, str):
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return raw
+    return raw
+
+
+def _predicate_matches(flt: dict, doc: dict) -> bool:
+    """One {field, operation, value} predicate (reference FilterOperation
+    set: eq, neq, lt, lte, gt, gte, in, isEmpty, iLike)."""
+    field = flt.get("field")
+    op = (flt.get("operation") or "eq")
+    if not field:
+        raise _FilterError("filter predicate missing field")
+    actual = _field_value(doc, field)
+    if op == "isEmpty":
+        return actual is None or actual == "" or actual == []
+    raw = flt.get("value")
+    value = _coerce_json(raw)
+    if op == "eq":
+        # string fields whose content happens to parse as JSON (e.g. the
+        # literal string "2024") must still match: compare raw AND coerced
+        return actual == raw or actual == value
+    if op == "neq":
+        return not (actual == raw or actual == value)
+    if op == "in":
+        options = value if isinstance(value, list) else [value]
+        return actual in options
+    if op == "iLike":
+        if not isinstance(actual, str) or not isinstance(value, str):
+            return False
+        # SQL LIKE: % is the wildcard; everything else literal,
+        # case-insensitive
+        pattern = ".*".join(
+            re.escape(part) for part in value.lower().split("%")
+        )
+        return re.fullmatch(pattern, actual.lower()) is not None
+    if op in ("lt", "lte", "gt", "gte"):
+        try:
+            a, v = float(actual), float(value)
+        except (TypeError, ValueError):
+            return False
+        return {"lt": a < v, "lte": a <= v,
+                "gt": a > v, "gte": a >= v}[op]
+    raise _FilterError(f"unknown filter operation {op!r}")
+
+
+def _filter_groups_match(groups: list, doc: dict) -> bool:
+    """Groups AND together; predicates inside a group combine with the
+    group operator (and/or, default and)."""
+    for group in groups or []:
+        predicates = group.get("filters") or []
+        if not predicates:
+            continue
+        operator = group.get("operator") or "and"
+        if operator not in ("and", "or"):
+            raise _FilterError(f"unknown filter group operator {operator!r}")
+        results = [_predicate_matches(f, doc) for f in predicates]
+        combined = any(results) if operator == "or" else all(results)
+        if not combined:
+            return False
+    return True
 
 
 def _op_status(code=200, message="success"):
@@ -248,10 +330,24 @@ class ResourceService:
         return {"operation_status": _op_status()}
 
     def read(self, filters: Optional[dict] = None) -> dict:
+        """``filters`` accepts the ids shorthand ({"ids": [...]}) or the
+        resource-base filter DSL ({"filters": [group, ...]}, reference:
+        resource-base-interface FilterOperation via
+        resourceManager.ts:61-68): groups of {field, operation, value}
+        predicates, predicates combined by the group operator (and/or),
+        groups combined with AND."""
         docs = self.collection.all()
         if filters and "ids" in filters:
             wanted = set(filters["ids"])
             docs = [d for d in docs if d["id"] in wanted]
+        elif filters and filters.get("filters"):
+            try:
+                docs = [
+                    d for d in docs
+                    if _filter_groups_match(filters["filters"], d)
+                ]
+            except _FilterError as err:
+                return {"operation_status": _op_status(400, str(err))}
         return {
             "items": [{"payload": d, "status": _op_status()} for d in docs],
             "operation_status": _op_status(),

@@ -517,9 +517,22 @@ class GrpcServer:
             )
 
         def read(request, context):
-            result = service.read(
-                {"ids": list(request.ids)} if request.ids else None
-            )
+            filters = None
+            if request.ids:
+                filters = {"ids": list(request.ids)}
+            elif request.filters:
+                filters = {"filters": [
+                    {
+                        "operator": group.operator or "and",
+                        "filters": [
+                            {"field": f.field, "operation": f.operation,
+                             "value": f.value}
+                            for f in group.filters
+                        ],
+                    }
+                    for group in request.filters
+                ]}
+            result = service.read(filters)
             resp = list_resp_cls()
             for item in result.get("items", []):
                 payload = item.get("payload")

@@ -66,6 +66,18 @@ class Worker:
         self.logger = logger or make_logger()
         self.telemetry = Telemetry()
 
+        # XLA dump hook (SURVEY section 5): best-effort — the flag is read
+        # at backend initialization, so it only takes effect when set
+        # before the first jax dispatch of the process
+        dump_dir = cfg.get("profiling:xla_dump_dir")
+        if dump_dir:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_dump_to" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_dump_to={dump_dir}"
+                ).strip()
+                self.logger.info("XLA dump enabled", extra={"dir": dump_dir})
+
         # event bus + offsets + subject cache: in-process by default;
         # a configured broker address switches all three to the
         # cross-process TCP backend (srv/broker.py — the reference's
@@ -259,6 +271,16 @@ class Worker:
         from ..models.model import Attribute, Request, Target
 
         if not self.cfg.get("authorization:enabled"):
+            return Decision.PERMIT
+        # api-key bypass: a subject bearing the operator key set via the
+        # set_api_key command (or authentication:apiKey config) skips
+        # self-authorization (chassis behavior the reference's suite
+        # exercises, microservice_acs_enabled.spec.ts set_api_key flow)
+        api_key = None
+        if getattr(self, "command_interface", None) is not None:
+            api_key = self.command_interface.api_key
+        api_key = api_key or self.cfg.get("authentication:apiKey")
+        if api_key and subject and subject.get("token") == api_key:
             return Decision.PERMIT
 
         urns = self.engine.urns

@@ -1,0 +1,154 @@
+"""Self-authorization hardening over the WIRE (VERDICT r2 item 9):
+exact 403 message texts, runtime config_update and the set_api_key bypass
+all driven end-to-end through the gRPC transport — the reference's
+microservice_acs_enabled.spec.ts flow (:379-1075, :613-617)."""
+
+import threading
+
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+from .test_srv_acs import (
+    HR_TREE,
+    TEST_ENTITY,
+    denied_message,
+    role_associations,
+)
+from .utils import URNS, fixture, marshall_yaml_policies
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+SUBJECT_ID_URN = "urn:oasis:names:tc:xacml:1.0:subject:subject-id"
+
+
+@pytest.fixture(scope="module")
+def rig():
+    w = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "authorization": {"enabled": False, "enforce": False,
+                              "hrReqTimeout": 2000},
+        }
+    )
+    w.identity_client.register(
+        "admin_token",
+        {
+            "id": "admin_user_id",
+            "tokens": [{"token": "admin_token"}],
+            "role_associations": role_associations("admin-r-id"),
+        },
+    )
+    auth_topic = w.bus.topic("io.restorecommerce.authentication")
+
+    def responder(event_name, message, ctx):
+        if event_name != "hierarchicalScopesRequest":
+            return
+
+        def reply():
+            auth_topic.emit(
+                "hierarchicalScopesResponse",
+                {
+                    "token": message["token"],
+                    "subject_id": "admin_user_id",
+                    "hierarchical_scopes": HR_TREE,
+                },
+            )
+
+        threading.Thread(target=reply, daemon=True).start()
+
+    auth_topic.on(responder)
+
+    # seed the self-auth policies while ACS is off
+    policy_sets, policies, rules = marshall_yaml_policies(
+        fixture("default_policies.yml")
+    )
+    w.store.get_resource_service("policy_set").create(policy_sets)
+    w.store.get_resource_service("policy").create(policies)
+    w.store.get_resource_service("rule").create(rules)
+
+    server = GrpcServer(w, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    yield w, client
+    client.close()
+    server.stop()
+    w.stop()
+
+
+def wire_rule(rule_id: str, owner_instance: str = "orgC") -> pb.Rule:
+    rule = pb.Rule(id=rule_id, name=f"rule {rule_id}", effect="PERMIT")
+    rule.target.subjects.add(id=SUBJECT_ID_URN, value="test-r-id")
+    rule.target.resources.add(id=URNS["entity"], value=TEST_ENTITY)
+    owner = rule.meta.owners.add(
+        id=URNS["ownerIndicatoryEntity"], value=ORG
+    )
+    owner.attributes.add(id=URNS["ownerInstance"], value=owner_instance)
+    return rule
+
+
+def admin_pb_subject(scope: str = "orgC") -> pb.Subject:
+    return pb.Subject(id="admin_user_id", token="admin_token", scope=scope)
+
+
+def test_config_update_toggles_authorization_over_wire(rig):
+    worker, client = rig
+    assert worker.cfg.get("authorization:enabled") is False
+
+    out = client.command("config_update", {"authorization:enabled": True})
+    assert out["status"] == "updated"
+    assert worker.cfg.get("authorization:enabled") is True
+
+    # invalid scope now denied with the reference's exact 403 text
+    result = client.crud(
+        "rule", "Create",
+        pb.RuleList(items=[wire_rule("wire_acs_r1", "INVALID")],
+                    subject=admin_pb_subject(scope="orgA")),
+    )
+    assert result.operation_status.code == 403
+    assert result.operation_status.message == denied_message(
+        "admin_user_id", "rule", "CREATE", "orgA"
+    )
+
+    # valid scope + owner permits over the wire
+    result = client.crud(
+        "rule", "Create",
+        pb.RuleList(items=[wire_rule("wire_acs_r2")],
+                    subject=admin_pb_subject(scope="orgC")),
+    )
+    assert result.operation_status.code == 200
+
+
+def test_set_api_key_bypass_over_wire(rig):
+    worker, client = rig
+    client.command("config_update", {"authorization:enabled": True})
+
+    # no key set: an unknown operator subject is denied
+    nobody = pb.Subject(id="ops", token="ops-secret-key", scope="orgA")
+    result = client.crud(
+        "rule", "Create",
+        pb.RuleList(items=[wire_rule("wire_acs_r3")], subject=nobody),
+    )
+    assert result.operation_status.code == 403
+    assert result.operation_status.message == denied_message(
+        "ops", "rule", "CREATE", "orgA"
+    )
+
+    # set_api_key over the wire: the same subject now bypasses ACS
+    out = client.command(
+        "set_api_key", {"authentication": {"apiKey": "ops-secret-key"}}
+    )
+    assert out["status"] == "set"
+    result = client.crud(
+        "rule", "Create",
+        pb.RuleList(items=[wire_rule("wire_acs_r3")], subject=nobody),
+    )
+    assert result.operation_status.code == 200
+
+    # a wrong key still goes through ACS and is denied
+    wrong = pb.Subject(id="ops", token="not-the-key", scope="orgA")
+    result = client.crud(
+        "rule", "Create",
+        pb.RuleList(items=[wire_rule("wire_acs_r4")], subject=wrong),
+    )
+    assert result.operation_status.code == 403

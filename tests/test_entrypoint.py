@@ -1,0 +1,59 @@
+"""Process entrypoint: python -m access_control_srv_tpu serves the gRPC
+surface and shuts down cleanly on SIGINT (reference: src/start.ts:6-21)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_main_serves_and_stops_on_sigint(tmp_path):
+    cfg_dir = tmp_path / "cfg"
+    cfg_dir.mkdir()
+    (cfg_dir / "config.json").write_text(
+        json.dumps({"policies": {"type": "local", "paths": []}})
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "access_control_srv_tpu",
+         "--config-dir", str(cfg_dir), "--addr", "127.0.0.1:0"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("serving on 127.0.0.1:"), line
+        addr = line.split()[-1]
+        client = GrpcClient(addr)
+        assert client.health() == "SERVING"
+        client.close()
+    finally:
+        proc.send_signal(signal.SIGINT)
+        out, err = proc.communicate(timeout=30)
+    assert "shutting down" in out, (out, err)
+    assert proc.returncode == 0
+
+
+def test_main_broker_mode():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "access_control_srv_tpu",
+         "--broker", "--addr", "127.0.0.1:0"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("broker listening on "), line
+        address = line.split()[-1]
+        from access_control_srv_tpu.srv.broker import SocketEventBus
+
+        bus = SocketEventBus(address)
+        off = bus.topic("t").emit("e", {"ok": 1})
+        assert off == 0
+        bus.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 0

@@ -1,0 +1,109 @@
+"""Resource read filter DSL (VERDICT r2 missing #4): the resource-base
+FilterOperation surface (eq/neq/in/lt/lte/gt/gte/isEmpty/iLike, and/or
+groups) on store reads, in-process and over the gRPC wire (reference:
+resourceManager.ts:61-68 makeFilter + resource-base-interface)."""
+
+import json
+
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+
+@pytest.fixture(scope="module")
+def rig():
+    w = Worker().start({"policies": {"type": "database"}})
+    rules = w.store.get_resource_service("rule")
+    rules.create([
+        {"id": "r1", "name": "alpha rule", "effect": "PERMIT",
+         "description": "one"},
+        {"id": "r2", "name": "beta rule", "effect": "DENY",
+         "description": "two"},
+        {"id": "r3", "name": "ALPHA special", "effect": "PERMIT",
+         "description": "three"},
+        {"id": "r4", "name": "gamma", "effect": "PERMIT",
+         "description": ""},
+    ])
+    server = GrpcServer(w, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    yield w, client
+    client.close()
+    server.stop()
+    w.stop()
+
+
+def ids(result):
+    return sorted(item["payload"]["id"] for item in result["items"])
+
+
+def test_filter_operations(rig):
+    worker, _ = rig
+    rules = worker.store.get_resource_service("rule")
+
+    def read(groups):
+        return rules.read({"filters": groups})
+
+    assert ids(read([{"filters": [
+        {"field": "effect", "operation": "eq", "value": "PERMIT"}
+    ]}])) == ["r1", "r3", "r4"]
+
+    assert ids(read([{"filters": [
+        {"field": "effect", "operation": "neq", "value": "PERMIT"}
+    ]}])) == ["r2"]
+
+    # the reference's makeFilter shape: id in [...] (JSON value)
+    assert ids(read([{"filters": [
+        {"field": "id", "operation": "in", "value": json.dumps(["r1", "r2"])}
+    ]}])) == ["r1", "r2"]
+
+    assert ids(read([{"filters": [
+        {"field": "name", "operation": "iLike", "value": "alpha%"}
+    ]}])) == ["r1", "r3"]
+
+    assert ids(read([{"filters": [
+        {"field": "description", "operation": "isEmpty"}
+    ]}])) == ["r4"]
+
+    # or-group + AND across groups
+    assert ids(read([
+        {"operator": "or", "filters": [
+            {"field": "id", "operation": "eq", "value": "r1"},
+            {"field": "id", "operation": "eq", "value": "r2"},
+        ]},
+        {"filters": [
+            {"field": "effect", "operation": "eq", "value": "PERMIT"},
+        ]},
+    ])) == ["r1"]
+
+    bad = read([{"filters": [
+        {"field": "id", "operation": "regex", "value": "x"}
+    ]}])
+    assert bad["operation_status"]["code"] == 400
+
+
+def test_filters_over_wire(rig):
+    _, client = rig
+    req = pb.ReadRequest()
+    group = req.filters.add(operator="or")
+    group.filters.add(field="id", operation="eq", value="r1")
+    group.filters.add(field="name", operation="iLike", value="%special")
+    resp = client.crud("rule", "Read", req, pb.RuleListResponse)
+    assert sorted(i.id for i in resp.items) == ["r1", "r3"]
+    assert resp.operation_status.code == 200
+
+
+def test_eq_matches_json_looking_strings_and_bad_operator(rig):
+    worker, _ = rig
+    rules = worker.store.get_resource_service("rule")
+    rules.create([{"id": "r5", "name": "2024", "effect": "PERMIT",
+                   "description": "year"}])
+    result = rules.read({"filters": [{"filters": [
+        {"field": "name", "operation": "eq", "value": "2024"}
+    ]}]})
+    assert ids(result) == ["r5"]  # "2024" must not coerce away from the string
+    bad = rules.read({"filters": [{"operator": "XOR", "filters": [
+        {"field": "id", "operation": "eq", "value": "r5"}
+    ]}]})
+    assert bad["operation_status"]["code"] == 400

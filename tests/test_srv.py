@@ -539,3 +539,26 @@ class TestConcurrentMutationServing:
             assert not errors, errors[0]
         finally:
             w.stop()
+
+
+def test_profile_command_captures_trace(tmp_path):
+    """The profile command (SURVEY section 5 tracing substitute) starts and
+    stops a JAX device trace at runtime and writes trace artifacts."""
+    w = Worker().start(seed_cfg())
+    try:
+        trace_dir = str(tmp_path / "trace")
+        out = w.command_interface.command(
+            "profile", {"action": "start", "dir": trace_dir}
+        )
+        assert out == {"status": "tracing", "dir": trace_dir}
+        # some device work while tracing
+        w.service.is_allowed(admin_request())
+        out = w.command_interface.command("profile", {"action": "stop"})
+        assert out["status"] == "stopped" and out["dir"] == trace_dir
+        files = [p for p in __import__("pathlib").Path(trace_dir).rglob("*")
+                 if p.is_file()]
+        assert files  # trace artifacts landed
+        bad = w.command_interface.command("profile", {"action": "bogus"})
+        assert "error" in bad
+    finally:
+        w.stop()
