@@ -67,7 +67,12 @@ class HybridEvaluator:
             )
             kernel = None
             if compiled.supported and compiled.n_rules > 0:
-                kernel = DecisionKernel(compiled)
+                # PrefilteredKernel is a drop-in DecisionKernel that keeps
+                # per-request work O(matching rules) on large trees and
+                # delegates to the dense kernel below MIN_RULES
+                from ..ops.prefilter import PrefilteredKernel
+
+                kernel = PrefilteredKernel(compiled)
             native_encoder = self._make_native_encoder(compiled, kernel)
             with self._lock:
                 if version >= self._version:  # drop stale compiles

@@ -217,3 +217,42 @@ def test_prefilter_batch_larger_than_cache():
     dd, dc, ds = dense.evaluate(batch)
     assert np.array_equal(pd_, dd)
     assert len(pre._subs) <= 2
+
+
+def test_evaluator_serves_large_trees_prefiltered():
+    """The serving shell's batch path uses the prefiltered kernel for
+    trees above MIN_RULES (drop-in; dense below)."""
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    doc, entities, actions = _stress_doc()  # ~720 rules
+    urns = Urns()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    ev = HybridEvaluator(engine)
+    assert isinstance(ev._kernel, PrefilteredKernel) and ev._kernel.active
+
+    def mk(i):
+        return Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=urns["role"], value=f"role-{i % 23}"),
+                    Attribute(id=urns["subjectID"], value=f"u{i}"),
+                ],
+                resources=[Attribute(id=urns["entity"],
+                                     value=entities[i % len(entities)])],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=actions[i % len(actions)])],
+            ),
+            context={"resources": [],
+                     "subject": {"id": f"u{i}",
+                                 "role_associations": [
+                                     {"role": f"role-{i % 23}",
+                                      "attributes": []}],
+                                 "hierarchical_scopes": []}},
+        )
+
+    reqs = [mk(i) for i in range(40)]
+    responses = ev.is_allowed_batch(reqs)
+    for req, resp in zip(reqs, responses):
+        assert resp.decision == engine.is_allowed(req).decision
