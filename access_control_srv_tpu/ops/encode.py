@@ -199,6 +199,9 @@ class RequestBatch:
     requests: list[Request] = field(default_factory=list)
     # per-reason counts for rows that fell back to the scalar oracle
     ineligible_reasons: dict[str, int] = field(default_factory=dict)
+    # (condition index, row) -> error text for abort rows (the reference's
+    # operation_status.message, recovered without an oracle re-run)
+    cond_msg: dict = field(default_factory=dict)
 
 
 class _RegexCache:
@@ -683,6 +686,7 @@ def encode_requests(
     cond_abort = np.zeros((C, B), bool)
     cond_code = np.full((C, B), 200, np.int32)
     cand_cache: dict[tuple, np.ndarray] = {}
+    cond_msg: dict[tuple[int, int], str] = {}
     for ci, cc in enumerate([] if skip_conditions else compiled.conditions):
         has_query = cc.context_query is not None and (
             getattr(cc.context_query, "filters", None)
@@ -710,6 +714,10 @@ def encode_requests(
                 code = getattr(err, "code", 500)
                 cond_abort[ci, b] = True
                 cond_code[ci, b] = code if isinstance(code, int) else 500
+                # the reference surfaces the error text in
+                # operation_status.message (accessController.ts:259-270);
+                # cached here so abort rows need no oracle re-run
+                cond_msg[(ci, b)] = str(err) or "Unknown Error!"
 
     return RequestBatch(
         B=B,
@@ -722,6 +730,7 @@ def encode_requests(
         eligible=eligible,
         requests=requests,
         ineligible_reasons=ineligible_reasons,
+        cond_msg=cond_msg,
     )
 
 

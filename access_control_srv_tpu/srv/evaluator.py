@@ -208,11 +208,35 @@ class HybridEvaluator:
         )
         self._count_path("oracle", n_oracle)
         self._count_path("kernel", len(requests) - n_oracle)
+        C = batch.cond_true.shape[0]
         responses: list[Response] = []
         for b, request in enumerate(requests):
+            if batch.eligible[b] and status[b] != 200:
+                # abort row: the pre-pass cached the condition error text;
+                # when exactly one aborting condition matches the row's
+                # status code the message is unambiguous and the oracle
+                # re-run is skipped (reference error shape:
+                # accessController.ts:259-270 — DENY + code + message)
+                msgs = {
+                    batch.cond_msg.get((ci, b))
+                    for ci in range(C)
+                    if batch.cond_abort[ci][b]
+                    and batch.cond_code[ci][b] == status[b]
+                }
+                if len(msgs) == 1 and None not in msgs:
+                    cach = None if cacheable[b] < 0 else bool(cacheable[b])
+                    responses.append(Response(
+                        decision=Decision.DENY,
+                        obligations=[],
+                        evaluation_cacheable=cach,
+                        operation_status=OperationStatus(
+                            code=int(status[b]), message=msgs.pop()
+                        ),
+                    ))
+                    continue
             if not batch.eligible[b] or status[b] != 200:
-                # ineligible rows and error-status rows take the oracle path
-                # (the latter to recover exact error messages)
+                # ineligible rows (and ambiguous abort rows) take the
+                # oracle path
                 responses.append(self.engine.is_allowed(request))
                 continue
             cach = None if cacheable[b] < 0 else bool(cacheable[b])

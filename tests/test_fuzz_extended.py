@@ -1,0 +1,173 @@
+"""Extended randomized differential: one fuzz pass drives all three device
+paths — dense kernel, candidate-compacted (prefiltered) kernel and the
+batched reverse query — against the scalar oracle on policy/request shapes
+the base generator does not reach:
+
+- entities from FOREIGN URN namespaces (exercises the regex-mode prefix
+  mismatch RESET, kernel sticky-state scan; reference
+  accessController.ts:545-566);
+- occasional None ACL entity/instance values (must fall back, advisor r2);
+- deep hierarchical-scope trees (adaptive caps path);
+- wider property lists and mixed operation/entity requests."""
+
+import copy
+import random
+
+import numpy as np
+
+from access_control_srv_tpu.core import AccessController
+from access_control_srv_tpu.core.loader import load_policy_sets
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    PrefilteredKernel,
+    ReverseQueryKernel,
+    compile_policies,
+    encode_requests,
+    what_is_allowed_batch,
+)
+
+from .test_kernel_differential import (
+    ACTIONS,
+    DEC_CODE,
+    ENTITIES,
+    OWNERS,
+    PROPS,
+    ROLES,
+    SUBJECTS,
+    _random_policy_tree,
+)
+from .test_prefilter import force_active
+from .test_reverse import rq_shape
+from .utils import URNS, build_request
+
+FOREIGN = [
+    "urn:acme:models:gadget.Gadget",
+    "urn:other:ns:thing.Thing",
+    "urn:restorecommerce:acs:model:widget.Widget",
+]
+
+
+def _extended_tree(rng: random.Random):
+    """Base random tree with some entity values swapped to foreign
+    namespaces (regex prefix comparisons now genuinely differ)."""
+    doc = _random_policy_tree(rng)
+    for ps in doc["policy_sets"]:
+        for pol in ps["policies"]:
+            for node in [pol] + list(pol.get("rules") or []):
+                tgt = node.get("target") or {}
+                for attr in tgt.get("resources") or []:
+                    if attr["id"] == URNS["entity"] and rng.random() < 0.3:
+                        attr["value"] = rng.choice(FOREIGN)
+    return doc
+
+
+def _deep_scopes(rng: random.Random):
+    depth = rng.randint(3, 6)
+
+    def node(i, d):
+        out = {"id": f"n{d}-{i}"}
+        if d < depth:
+            out["children"] = [node(j, d + 1) for j in range(2)]
+        if rng.random() < 0.3:
+            out["role"] = rng.choice(ROLES)
+        return out
+
+    return [node(0, 0)]
+
+
+def _extended_requests(rng: random.Random, n: int):
+    out = []
+    pool = ENTITIES + FOREIGN
+    for i in range(n):
+        multi = rng.random() < 0.35
+        rtype = rng.sample(pool, 2) if multi else rng.choice(pool)
+        rid = [f"id-{k}" for k in range(2)] if multi else "id-0"
+        kwargs = dict(
+            subject_id=rng.choice(SUBJECTS),
+            subject_role=rng.choice(ROLES),
+            role_scoping_entity=(
+                "urn:restorecommerce:acs:model:organization.Organization"
+            ),
+            role_scoping_instance=rng.choice(OWNERS),
+            resource_type=rtype,
+            resource_id=rid,
+            action_type=rng.choice(ACTIONS[:4]),
+        )
+        if rng.random() < 0.6:
+            kwargs["resource_property"] = rng.sample(PROPS, rng.randint(1, 3))
+        if rng.random() < 0.5:
+            kwargs["owner_indicatory_entity"] = (
+                "urn:restorecommerce:acs:model:organization.Organization"
+            )
+            kwargs["owner_instance"] = (
+                [rng.choice(OWNERS), rng.choice(OWNERS)] if multi
+                else rng.choice(OWNERS)
+            )
+        if rng.random() < 0.25:
+            kwargs["acl_indicatory_entity"] = rng.choice(pool[:2])
+            kwargs["acl_instances"] = rng.sample(OWNERS, rng.randint(1, 2))
+        request = build_request(**kwargs)
+        if rng.random() < 0.2:
+            request.context["subject"]["hierarchical_scopes"] = (
+                _deep_scopes(rng)
+            )
+        if rng.random() < 0.1:
+            # inject a None ACL value: must fall back, never diverge
+            request.context["resources"].append({
+                "id": "id-0",
+                "meta": {"owners": [], "acls": [{
+                    "id": URNS["aclIndicatoryEntity"], "value": None,
+                    "attributes": [
+                        {"id": URNS["aclInstance"], "value": "x"}
+                    ],
+                }]},
+            })
+        out.append(request)
+    return out
+
+
+def test_extended_fuzz_all_device_paths():
+    rng = random.Random(9000)
+    total_eligible = 0
+    for round_ in range(8):
+        doc = _extended_tree(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        requests = _extended_requests(rng, 40)
+
+        batch = encode_requests(requests, compiled)
+        dense = DecisionKernel(compiled)
+        dd, dc, ds = dense.evaluate(batch)
+        pre = force_active(PrefilteredKernel(compiled))
+        pd_, pc, ps_ = pre.evaluate(batch)
+        assert np.array_equal(dd, pd_), f"round {round_}: prefilter != dense"
+        assert np.array_equal(dc, pc)
+        assert np.array_equal(ds, ps_)
+
+        for b, request in enumerate(requests):
+            expected = engine.is_allowed(copy.deepcopy(request))
+            if not batch.eligible[b]:
+                continue
+            total_eligible += 1
+            assert dd[b] == DEC_CODE[expected.decision], (
+                f"round {round_} request {b}: kernel={dd[b]} "
+                f"oracle={expected.decision}"
+            )
+
+        rq_kernel = ReverseQueryKernel(compiled, engine.policy_sets)
+        oracle_rq = [
+            engine.what_is_allowed(copy.deepcopy(r)) for r in requests
+        ]
+        kernel_rq = what_is_allowed_batch(
+            engine, compiled, rq_kernel,
+            [copy.deepcopy(r) for r in requests],
+        )
+        for b in range(len(requests)):
+            assert rq_shape(kernel_rq[b]) == rq_shape(oracle_rq[b]), (
+                f"round {round_} request {b}: reverse query diverged"
+            )
+    assert total_eligible > 120  # the fuzz must exercise the device path

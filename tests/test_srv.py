@@ -562,3 +562,56 @@ def test_profile_command_captures_trace(tmp_path):
         assert "error" in bad
     finally:
         w.stop()
+
+
+def test_abort_rows_recover_exact_message_without_oracle_rerun():
+    """Condition-error rows on the batch path return the reference's exact
+    operation_status.message from the pre-pass cache instead of
+    re-evaluating on the oracle (round-2 weak #6)."""
+    from access_control_srv_tpu.core.loader import load_policy_sets
+
+    w = Worker().start({"policies": {"type": "local", "paths": []}})
+    try:
+        doc = {
+            "policy_sets": [{
+                "id": "ps_err", "combining_algorithm": PO,
+                "policies": [{
+                    "id": "p_err", "combining_algorithm": PO,
+                    "rules": [{
+                        "id": "r_err", "effect": "PERMIT",
+                        "target": {
+                            "resources": [{"id": URNS["entity"],
+                                           "value": ORG}],
+                        },
+                        # missing attribute raises at evaluation time
+                        "condition": "context.subject.nonexistent_field == 1",
+                    }],
+                }],
+            }]
+        }
+        for ps in load_policy_sets(doc):
+            w.engine.update_policy_set(ps)
+        w.evaluator.refresh()
+
+        request = build_request(
+            subject_id="ada", subject_role="member",
+            resource_type=ORG, resource_id="X", action_type=READ,
+        )
+        expected = w.engine.is_allowed(request)
+        assert expected.operation_status.code != 200
+
+        calls = []
+        original = w.engine.is_allowed
+        w.engine.is_allowed = lambda r: (calls.append(r) or original(r))
+        try:
+            responses = w.evaluator.is_allowed_batch([request])
+        finally:
+            w.engine.is_allowed = original
+        assert responses[0].decision == expected.decision
+        assert responses[0].operation_status.code == \
+            expected.operation_status.code
+        assert responses[0].operation_status.message == \
+            expected.operation_status.message
+        assert not calls  # no oracle re-run for the abort row
+    finally:
+        w.stop()
