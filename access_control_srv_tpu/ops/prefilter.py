@@ -176,13 +176,20 @@ class PrefilteredKernel:
     the dense kernel (differential: tests/test_prefilter.py); trees under
     MIN_RULES rules skip the machinery entirely."""
 
-    def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024):
+    def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024,
+                 mesh=None, axis: str = "data"):
+        """``mesh``: optional jax.sharding.Mesh — requests shard
+        data-parallel over ``axis`` while the stacked subtrees and regex
+        matrices replicate (the multi-chip layout of parallel/mesh.py
+        applied to the candidate-compacted dispatch)."""
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
             )
         self.compiled = compiled
         self.cache_size = cache_size
+        self.mesh = mesh
+        self.axis = axis
         self._subs: dict[tuple, CompiledPolicies] = {}
         self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
         self._dense: DecisionKernel | None = None
@@ -217,7 +224,21 @@ class PrefilteredKernel:
                     cond_true.T, cond_abort.T, cond_code.T,
                 )
 
-            run = self._runs[key] = jax.jit(run)
+            if self.mesh is None:
+                run = jax.jit(run)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self.mesh, P())
+                data = NamedSharding(self.mesh, P(self.axis))
+                cond = NamedSharding(self.mesh, P(None, self.axis))
+                run = jax.jit(
+                    run,
+                    in_shardings=(repl, data, data, repl, repl,
+                                  cond, cond, cond),
+                    out_shardings=(data, data, data),
+                )
+            self._runs[key] = run
         return run
 
     # ---------------------------------------------------------------- caches
@@ -296,6 +317,21 @@ class PrefilteredKernel:
         stacked = self._stack(tuple(keys), subs)
 
         _, bucket, e_bucket, pad_lead = lead_padding(batch)
+        if self.mesh is not None:
+            # even sharding over the data axis: both are powers of two in
+            # practice, but guard the general case
+            n_data = self.mesh.shape[self.axis]
+            if bucket % n_data:
+                bucket = -(-bucket // n_data) * n_data
+
+            def pad_lead(a, _bucket=bucket):  # noqa: F811
+                a = np.asarray(a)
+                if a.shape[0] == _bucket:
+                    return a
+                fill = np.zeros((_bucket - a.shape[0],) + a.shape[1:],
+                                a.dtype)
+                return np.concatenate([a, fill], axis=0)
+
         g_idx = pad_lead(inv.astype(np.int32).reshape(B))
         run = self._runner(
             bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),

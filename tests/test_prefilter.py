@@ -256,3 +256,50 @@ def test_evaluator_serves_large_trees_prefiltered():
     responses = ev.is_allowed_batch(reqs)
     for req, resp in zip(reqs, responses):
         assert resp.decision == engine.is_allowed(req).decision
+
+
+def test_prefilter_sharded_over_mesh():
+    """Prefiltered kernel with a data-parallel mesh: identical decisions
+    to the single-device dispatch (8 virtual CPU devices)."""
+    import jax
+
+    from access_control_srv_tpu.parallel import make_mesh
+
+    doc, entities, actions = _stress_doc()
+    urns = Urns()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    single = PrefilteredKernel(compiled)
+    n = min(8, len(jax.devices()))
+    sharded = PrefilteredKernel(compiled, mesh=make_mesh(n))
+    assert single.active and sharded.active
+
+    rng = random.Random(17)
+    reqs = []
+    for i in range(64):
+        reqs.append(Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=urns["role"], value=f"role-{i % 23}"),
+                    Attribute(id=urns["subjectID"], value=f"u{i}"),
+                ],
+                resources=[Attribute(id=urns["entity"],
+                                     value=rng.choice(entities))],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=rng.choice(actions))],
+            ),
+            context={"resources": [],
+                     "subject": {"id": f"u{i}",
+                                 "role_associations": [
+                                     {"role": f"role-{i % 23}",
+                                      "attributes": []}],
+                                 "hierarchical_scopes": []}},
+        ))
+    batch = encode_requests(reqs, compiled)
+    d1, c1, s1 = single.evaluate(batch)
+    d2, c2, s2 = sharded.evaluate(batch)
+    assert np.array_equal(d1, d2)
+    assert np.array_equal(c1, c2)
+    assert np.array_equal(s1, s2)
