@@ -95,8 +95,33 @@ class ReverseQueryKernel:
         return {k: np.asarray(v)[:b] for k, v in out.items()}
 
 
+def _rule_match_cubes(compiled: CompiledPolicies, masks: dict):
+    """Vectorized per-rule wia verdicts for the whole batch.
+
+    ``rule_match[b, s, kp, kr]``: the oracle's final rule-target verdict
+    (no-target rules match; otherwise exact OR regex — the regex call is a
+    fallback, so the disjunction equals the sequential result).
+    ``rule_maskful[b, s, kp, kr]``: some mode of the rule's target row
+    could append obligations for row b — those rules must go through the
+    scalar matcher in oracle order, the rest can be collected wholesale."""
+    a = compiled.arrays
+    rt = a["rule_target"]  # [S, KP, KR]
+    deny = (a["rule_effect"] == 2)[None]
+    ex = np.where(deny, masks["tm_wia_ex_d"][:, rt],
+                  masks["tm_wia_ex_p"][:, rt])
+    rg = np.where(deny, masks["tm_wia_rg_d"][:, rt],
+                  masks["tm_wia_rg_p"][:, rt])
+    has_t = a["rule_has_target"][None]
+    rule_match = a["rule_valid"][None] & (~has_t | ex | rg)
+    rule_maskful = has_t & (
+        masks["maybe_mask_ex"][:, rt] | masks["maybe_mask_rg"][:, rt]
+    )
+    return rule_match, rule_maskful
+
+
 def _assemble(
-    engine, compiled: CompiledPolicies, sets, request, m
+    engine, compiled: CompiledPolicies, sets, request, m,
+    rule_match=None, rule_maskful=None,
 ) -> ReverseQuery:
     """Replay of AccessController.what_is_allowed (engine.py:373-499,
     reference accessController.ts:326-427) with device match vectors.
@@ -175,15 +200,35 @@ def _assemble(
                         combining_algorithm=policy.combining_algorithm,
                         has_rules=bool(policy.combinables),
                     )
-                    for kr, rule in enumerate(policy.combinables.values()):
+                    rules_list = list(policy.combinables.values())
+                    fast = (
+                        rule_match is not None
+                        and not rule_maskful[s, kp, :len(rules_list)].any()
+                    )
+                    if fast:
+                        # no rule of this policy can append obligations for
+                        # this request: collect matches wholesale from the
+                        # precomputed cube (identical verdicts, no side
+                        # effects to order)
+                        matching = np.nonzero(
+                            rule_match[s, kp, :len(rules_list)]
+                        )[0]
+                        rule_iter = ((kr, rules_list[kr]) for kr in matching)
+                    else:
+                        rule_iter = enumerate(rules_list)
+                    for kr, rule in rule_iter:
                         if rule is None:
                             continue
-                        rrow = int(a["rule_target"][s, kp, kr])
-                        matches = rule.target is None or tm(
-                            rrow, rule.target, rule.effect, False
-                        )
-                        if not matches:
-                            matches = tm(rrow, rule.target, rule.effect, True)
+                        if fast:
+                            matches = True
+                        else:
+                            rrow = int(a["rule_target"][s, kp, kr])
+                            matches = rule.target is None or tm(
+                                rrow, rule.target, rule.effect, False
+                            )
+                            if not matches:
+                                matches = tm(rrow, rule.target,
+                                             rule.effect, True)
                         if rule.target is None or matches:
                             policy_rq.rules.append(RuleRQ(
                                 id=rule.id,
@@ -223,11 +268,15 @@ def what_is_allowed_batch(
             requests, compiled, skip_conditions=True
         )
     masks = kernel.evaluate(batch)
+    rule_match, rule_maskful = _rule_match_cubes(compiled, masks)
     out = []
     for b, request in enumerate(requests):
         if not batch.eligible[b]:
             out.append(engine.what_is_allowed(request))
             continue
         m = {k: v[b] for k, v in masks.items()}
-        out.append(_assemble(engine, compiled, kernel.sets, request, m))
+        out.append(_assemble(
+            engine, compiled, kernel.sets, request, m,
+            rule_match[b], rule_maskful[b],
+        ))
     return out

@@ -237,6 +237,76 @@ def bench_what_is_allowed():
     )
 
 
+def bench_wia_large():
+    """whatIsAllowed at rule-count scale: the device-assisted reverse
+    query (match vectors on device, vectorized host assembly) vs the
+    scalar oracle on a ~1000-rule synthetic tree."""
+    import copy
+    import random
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.ops import (
+        ReverseQueryKernel,
+        compile_policies,
+        what_is_allowed_batch,
+    )
+
+    urns = Urns()
+    engine, n_rules = _stress_engine(int(os.environ.get("WIA_RULES", 1000)))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    kernel = ReverseQueryKernel(compiled, engine.policy_sets)
+
+    rng = random.Random(3)
+    n = int(os.environ.get("WIA_LARGE_N", 512))
+    requests = []
+    for i in range(n):
+        k = rng.randint(0, 63)
+        requests.append(Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=urns["role"], value=f"role-{i % 97}"),
+                    Attribute(id=urns["subjectID"], value=f"u{i}"),
+                ],
+                resources=[Attribute(
+                    id=urns["entity"],
+                    value=f"urn:restorecommerce:acs:model:stress{k}.Stress{k}",
+                )],
+                actions=[Attribute(
+                    id=urns["actionID"],
+                    value=[urns["read"], urns["modify"], urns["create"],
+                           urns["delete"]][i % 4],
+                )],
+            ),
+            context={"resources": [], "subject": {
+                "id": f"u{i}",
+                "role_associations": [{"role": f"role-{i % 97}",
+                                       "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        ))
+
+    t0 = time.perf_counter()
+    for r in requests[:128]:
+        engine.what_is_allowed(copy.deepcopy(r))
+    scalar_qps = 128 / (time.perf_counter() - t0)
+
+    what_is_allowed_batch(engine, compiled, kernel,
+                          [copy.deepcopy(r) for r in requests])  # warmup
+    timed = [copy.deepcopy(r) for r in requests]
+    t0 = time.perf_counter()
+    what_is_allowed_batch(engine, compiled, kernel, timed)
+    kernel_qps = n / (time.perf_counter() - t0)
+    return _result(
+        f"whatIsAllowed queries/sec ({n_rules}-rule tree)",
+        kernel_qps,
+        "queries/s",
+        {"n": n, "scalar_qps": round(scalar_qps, 1),
+         "kernel_qps": round(kernel_qps, 1),
+         "speedup_vs_scalar": round(kernel_qps / scalar_qps, 1)},
+    )
+
+
 # ------------------------------------------- config 4: HR scopes + conditions
 
 
@@ -596,8 +666,8 @@ def main():
                 "device0": info.get("device0"),
             }
 
-    which = sys.argv[1:] or ["scalar", "batched", "wia", "hr", "hr-deep",
-                             "stress"]
+    which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
+                             "hr-deep", "stress"]
     if backend is None:
         global ACCEL_OK
         ACCEL_OK = False
@@ -613,6 +683,7 @@ def main():
         "scalar": bench_scalar_cpu,
         "batched": bench_tpu_batched,
         "wia": bench_what_is_allowed,
+        "wia-large": bench_wia_large,
         "hr": bench_hr_conditions,
         "hr-deep": bench_hr_deep,
         "stress": bench_stress,
