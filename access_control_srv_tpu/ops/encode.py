@@ -76,13 +76,11 @@ def _pow2_at_least(n: int, floor: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def compute_caps(requests, urns) -> dict[str, int]:
-    """Pre-scan the batch and bucket every padding dimension to the next
-    power of two above the batch maximum (floor = module defaults, hard
-    ceiling = _CAPS_CEIL).  Estimates only need to be upper bounds per
-    dimension — the fill loop still marks genuinely over-cap rows
-    ineligible, so an under-estimate degrades to oracle fallback, never to
-    a wrong decision."""
+def request_needs(request, urns) -> dict[str, int]:
+    """Raw per-request padding needs (upper-bound estimates) for every cap
+    dimension; compute_caps aggregates these over a batch, the evaluator
+    uses them to split mixed traffic so deep/wide rows do not inflate the
+    compiled shapes of the whole batch."""
     entity_urn = urns.get("entity")
     property_urn = urns.get("property")
     operation_urn = urns.get("operation")
@@ -99,77 +97,96 @@ def compute_caps(requests, urns) -> dict[str, int]:
         if val > need[key]:
             need[key] = val
 
+    target = request.target
+    if not target:
+        return need
+    bump("NSUB", len(target.subjects or []))
+    bump("NACT", len(target.actions or []))
+    runs = props = ops = insts = 0
+    seen_run = False
+    for attr in target.resources or []:
+        if attr.id == entity_urn:
+            runs += 1
+            seen_run = True
+        elif attr.id == property_urn:
+            props += 1
+        elif attr.id == operation_urn:
+            ops += 1
+        elif attr.id == resource_id_urn and seen_run:
+            insts += 1
+    bump("NR", runs)
+    bump("NP", props)
+    bump("NOP", ops)
+    bump("NI", insts)
+
+    context = request.context
+    subject = get_field(context, "subject") or {} if context else {}
+    role_assocs = get_field(subject, "role_associations") or []
+    roles, ra3, ra2 = set(), 0, set()
+    for ra in role_assocs:
+        role = get_field(ra, "role")
+        if role is not None:
+            roles.add(role)
+        for ra_attr in get_field(ra, "attributes") or []:
+            if get_field(ra_attr, "id") != scoping_urn:
+                continue
+            ent = get_field(ra_attr, "value")
+            ra2.add((role, ent))
+            for inst in get_field(ra_attr, "attributes") or []:
+                if get_field(inst, "id") == scoping_inst_urn:
+                    ra3 += 1
+    bump("NROLE", len(roles))
+    bump("NRA", max(ra3, len(ra2)))
+
+    scopes = get_field(subject, "hierarchical_scopes")
+    hr_pairs: list = []
+    _flatten_hr(scopes, hr_pairs)
+    bump("NHR", len(set(hr_pairs)))
+    acl_hr: list = []
+    _flatten_acl_hr(scopes, acl_hr)
+    bump("NHR", len(set(acl_hr)))
+    bump("NHRR", len({r for r, _ in acl_hr if r is not None}))
+
+    acl_ents, acl_insts_total, own_max = set(), 0, 0
+    for res in (get_field(context, "resources") or []) if context else []:
+        meta = get_field(res, "meta")
+        for acl in (get_field(meta, "acls") or []) if meta else []:
+            if get_field(acl, "id") == acl_ind_urn:
+                acl_ents.add(get_field(acl, "value"))
+                acl_insts_total += len(get_field(acl, "attributes") or [])
+        own = 0
+        for owner in (get_field(meta, "owners") or []) if meta else []:
+            if get_field(owner, "id") != owner_ent_urn:
+                continue
+            own += sum(
+                1 for i in (get_field(owner, "attributes") or [])
+                if get_field(i, "id") == owner_inst_urn
+            )
+        own_max = max(own_max, own)
+    bump("NACLE", len(acl_ents))
+    bump("NACLI", acl_insts_total)
+    bump("NOWN", own_max)
+    return need
+
+
+def fits_floor(needs: dict[str, int]) -> bool:
+    """True when a request's needs fit the floor caps (the steady-state
+    compiled shape)."""
+    return all(needs[k] <= _CAPS_FLOOR[k] for k in _CAPS_FLOOR)
+
+
+def compute_caps(requests, urns) -> dict[str, int]:
+    """Pre-scan the batch and bucket every padding dimension to the next
+    power of two above the batch maximum (floor = module defaults, hard
+    ceiling = _CAPS_CEIL).  Estimates only need to be upper bounds per
+    dimension — the fill loop still marks genuinely over-cap rows
+    ineligible, so an under-estimate degrades to oracle fallback, never to
+    a wrong decision."""
+    need = dict.fromkeys(_CAPS_FLOOR, 0)
     for request in requests:
-        target = request.target
-        if not target:
-            continue
-        bump("NSUB", len(target.subjects or []))
-        bump("NACT", len(target.actions or []))
-        runs = props = ops = insts = 0
-        seen_run = False
-        for attr in target.resources or []:
-            if attr.id == entity_urn:
-                runs += 1
-                seen_run = True
-            elif attr.id == property_urn:
-                props += 1
-            elif attr.id == operation_urn:
-                ops += 1
-            elif attr.id == resource_id_urn and seen_run:
-                insts += 1
-        bump("NR", runs)
-        bump("NP", props)
-        bump("NOP", ops)
-        bump("NI", insts)
-
-        context = request.context
-        subject = get_field(context, "subject") or {} if context else {}
-        role_assocs = get_field(subject, "role_associations") or []
-        roles, ra3, ra2 = set(), 0, set()
-        for ra in role_assocs:
-            role = get_field(ra, "role")
-            if role is not None:
-                roles.add(role)
-            for ra_attr in get_field(ra, "attributes") or []:
-                if get_field(ra_attr, "id") != scoping_urn:
-                    continue
-                ent = get_field(ra_attr, "value")
-                ra2.add((role, ent))
-                for inst in get_field(ra_attr, "attributes") or []:
-                    if get_field(inst, "id") == scoping_inst_urn:
-                        ra3 += 1
-        bump("NROLE", len(roles))
-        bump("NRA", max(ra3, len(ra2)))
-
-        scopes = get_field(subject, "hierarchical_scopes")
-        hr_pairs: list = []
-        _flatten_hr(scopes, hr_pairs)
-        bump("NHR", len(set(hr_pairs)))
-        acl_hr: list = []
-        _flatten_acl_hr(scopes, acl_hr)
-        bump("NHR", len(set(acl_hr)))
-        bump("NHRR", len({r for r, _ in acl_hr if r is not None}))
-
-        acl_ents, acl_insts_total, own_max = set(), 0, 0
-        for res in (get_field(context, "resources") or []) if context else []:
-            meta = get_field(res, "meta")
-            for acl in (get_field(meta, "acls") or []) if meta else []:
-                if get_field(acl, "id") == acl_ind_urn:
-                    acl_ents.add(get_field(acl, "value"))
-                    acl_insts_total += len(get_field(acl, "attributes") or [])
-            own = 0
-            for owner in (get_field(meta, "owners") or []) if meta else []:
-                if get_field(owner, "id") != owner_ent_urn:
-                    continue
-                own += sum(
-                    1 for i in (get_field(owner, "attributes") or [])
-                    if get_field(i, "id") == owner_inst_urn
-                )
-            own_max = max(own_max, own)
-        bump("NACLE", len(acl_ents))
-        bump("NACLI", acl_insts_total)
-        bump("NOWN", own_max)
-
+        for key, val in request_needs(request, urns).items():
+            if val > need[key]:
+                need[key] = val
     return {
         key: min(_CAPS_CEIL[key], _pow2_at_least(need[key], _CAPS_FLOOR[key]))
         for key in _CAPS_FLOOR

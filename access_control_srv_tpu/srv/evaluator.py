@@ -200,7 +200,36 @@ class HybridEvaluator:
             self._count_path("oracle", len(requests))
             return [self.engine.is_allowed(r) for r in requests]
 
-        batch = encode_requests(requests, compiled, self.engine.resource_adapter)
+        # mixed-traffic split: a handful of deep/wide rows must not
+        # inflate the adaptive padding caps (and device cost) of the whole
+        # batch — encode floor-fitting rows at the steady-state compiled
+        # shape and only the rest at batch-max caps
+        if len(requests) >= 8:
+            from ..ops.encode import _CAPS_FLOOR, fits_floor, request_needs
+
+            ext = [
+                b for b, r in enumerate(requests)
+                if not fits_floor(request_needs(r, compiled.urns))
+            ]
+            if 0 < len(ext) < len(requests):
+                ext_set = set(ext)
+                floor_rows = [b for b in range(len(requests))
+                              if b not in ext_set]
+                out: list[Response] = [None] * len(requests)
+                for rows, caps in ((floor_rows, dict(_CAPS_FLOOR)),
+                                   (ext, None)):
+                    sub = self._eval_encoded(
+                        kernel, compiled, [requests[b] for b in rows], caps
+                    )
+                    for b, resp in zip(rows, sub):
+                        out[b] = resp
+                return out
+        return self._eval_encoded(kernel, compiled, requests, None)
+
+    def _eval_encoded(self, kernel, compiled, requests: list, caps):
+        batch = encode_requests(
+            requests, compiled, self.engine.resource_adapter, caps=caps
+        )
         decision, cacheable, status = kernel.evaluate(batch)
         n_oracle = sum(
             1 for b in range(len(requests))

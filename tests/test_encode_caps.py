@@ -95,3 +95,27 @@ def test_reason_counter_for_token_subjects():
     batch = encode_requests([req], compiled)
     assert not batch.eligible[0]
     assert batch.ineligible_reasons == {"token-subject": 1}
+
+
+def test_evaluator_splits_mixed_depth_batches():
+    """A few deep-HR rows must not inflate the compiled shapes of the
+    whole batch: the evaluator encodes floor-fitting rows separately and
+    all decisions stay bit-identical to the oracle."""
+    from access_control_srv_tpu.ops.encode import fits_floor, request_needs
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    engine = make_engine("role_scopes.yml")
+    ev = HybridEvaluator(engine)
+    shallow = [build_request(subject_id="ada", subject_role="member",
+                             role_scoping_entity=ORG,
+                             role_scoping_instance="Org1",
+                             resource_type=ORG, resource_id=f"X{i}",
+                             action_type=URNS["read"]) for i in range(12)]
+    deep = [deep_request(d) for d in (6, 7)]
+    assert all(fits_floor(request_needs(r, engine.urns)) for r in shallow)
+    assert not any(fits_floor(request_needs(r, engine.urns)) for r in deep)
+
+    mixed = shallow[:6] + deep + shallow[6:]
+    responses = ev.is_allowed_batch(mixed)
+    for req, resp in zip(mixed, responses):
+        assert resp.decision == engine.is_allowed(req).decision
