@@ -166,6 +166,34 @@ class AccessControlService:
                 for _ in reqs
             ]
 
+    def what_is_allowed_batch(self, requests: list) -> list[ReverseQuery]:
+        """Batched reverse query through the device-assisted path
+        (framework extension; single-request semantics per row with the
+        same deny-on-exception error shape)."""
+        t0 = time.perf_counter()
+        try:
+            reqs = [coerce_request(r) for r in requests]
+            if self.evaluator is not None:
+                out = self.evaluator.what_is_allowed_batch(reqs)
+            else:
+                out = [self.engine.what_is_allowed(r) for r in reqs]
+            self._observe("what_is_allowed_latency", t0)
+            return out
+        except Exception as err:
+            if self.logger:
+                self.logger.exception("whatIsAllowedBatch failed")
+            self._observe("what_is_allowed_latency", t0)
+            code = getattr(err, "code", 500)
+            status = OperationStatus(
+                code=code if isinstance(code, int) else 500,
+                message=str(err) or "Unknown Error!",
+            )
+            return [
+                ReverseQuery(policy_sets=[], obligations=[],
+                             operation_status=status)
+                for _ in requests
+            ]
+
     def what_is_allowed(self, request: Any) -> ReverseQuery:
         """(reference: accessControlService.ts:83-101)"""
         t0 = time.perf_counter()

@@ -421,6 +421,14 @@ class GrpcServer:
             rq = worker.service.what_is_allowed(request_from_pb(request))
             return reverse_query_to_pb(rq)
 
+        def what_is_allowed_batch(request, context):
+            rqs = worker.service.what_is_allowed_batch(
+                [request_from_pb(m) for m in request.requests]
+            )
+            return pb.BatchReverseQuery(
+                responses=[reverse_query_to_pb(rq) for rq in rqs]
+            )
+
         ac_handlers = {
             "IsAllowed": _unary(is_allowed, pb.Request, pb.Response),
             # raw-bytes deserializer: the handler splits the envelope
@@ -431,6 +439,11 @@ class GrpcServer:
                 response_serializer=pb.BatchResponse.SerializeToString,
             ),
             "WhatIsAllowed": _unary(what_is_allowed, pb.Request, pb.ReverseQuery),
+            # framework extension: batched reverse query through the
+            # device-assisted path (ops/reverse.py)
+            "WhatIsAllowedBatch": _unary(
+                what_is_allowed_batch, pb.BatchRequest, pb.BatchReverseQuery
+            ),
         }
         self.server.add_generic_rpc_handlers(
             (

@@ -122,3 +122,18 @@ def test_command_and_health_over_wire(rig):
     assert client.health() == "SERVING"
     version = client.command("version")
     assert version["version"]
+
+
+def test_what_is_allowed_batch_over_wire(rig):
+    worker, client = rig
+    batch = pb.BatchRequest()
+    for role in ("superadministrator-r-id", "nobody"):
+        batch.requests.add().CopyFrom(wire_request(role=role))
+    resp = client._call("acstpu.AccessControlService", "WhatIsAllowedBatch",
+                        batch, pb.BatchReverseQuery)
+    assert len(resp.responses) == 2
+    # per-row parity with the single-request endpoint
+    for i, role in enumerate(("superadministrator-r-id", "nobody")):
+        single = client.what_is_allowed(wire_request(role=role))
+        assert resp.responses[i].SerializeToString() == \
+            single.SerializeToString()
