@@ -18,6 +18,7 @@ import json
 import os
 import re
 import threading
+import time
 import uuid
 from typing import Callable, Optional
 
@@ -226,8 +227,21 @@ class ResourceService:
                     ],
                 }
             )
+        now = time.time()
         for item in items:
             meta = item.setdefault("meta", {})
+            # timestamp stamping (reference: resource-base fieldHandlers
+            # timeStampFields meta.created/meta.modified,
+            # cfg/config.json:324-331)
+            meta["modified"] = now
+            if action == "CREATE" or not meta.get("created"):
+                existing_meta = (
+                    self.read_meta_data(item.get("id", ""))
+                    if item.get("id") else None
+                )
+                meta["created"] = (
+                    (existing_meta or {}).get("created") or now
+                )
             if action in ("MODIFY", "DELETE"):
                 existing = self.read_meta_data(item.get("id", ""))
                 if existing and existing.get("owners"):

@@ -615,3 +615,24 @@ def test_abort_rows_recover_exact_message_without_oracle_rerun():
         assert not calls  # no oracle re-run for the abort row
     finally:
         w.stop()
+
+
+def test_meta_timestamps_stamped_and_preserved():
+    """meta.created is set at CREATE and preserved across MODIFY;
+    meta.modified updates on every mutation (reference: resource-base
+    fieldHandlers timeStampFields, cfg/config.json:324-331)."""
+    w = Worker().start({"policies": {"type": "database"}})
+    try:
+        rules = w.store.get_resource_service("rule")
+        rules.create([{"id": "r_ts", "name": "ts", "effect": "PERMIT"}])
+        doc = rules.read({"ids": ["r_ts"]})["items"][0]["payload"]
+        created = doc["meta"]["created"]
+        first_modified = doc["meta"]["modified"]
+        assert created and first_modified
+        time.sleep(0.01)
+        rules.update([{"id": "r_ts", "name": "ts2", "effect": "PERMIT"}])
+        doc = rules.read({"ids": ["r_ts"]})["items"][0]["payload"]
+        assert doc["meta"]["created"] == created  # preserved
+        assert doc["meta"]["modified"] > first_modified
+    finally:
+        w.stop()
