@@ -6,7 +6,18 @@ src/accessControlService.ts)."""
 from .config import Config
 from .events import EventBus, Topic
 from .cache import SubjectCache, HRScopeProvider
-from .identity import IdentityClient, StaticIdentityClient
+from .identity import (
+    GrpcIdentityClient,
+    IdentityClient,
+    MockIdentityServer,
+    StaticIdentityClient,
+)
+from .broker import (
+    BrokerServer,
+    SocketEventBus,
+    SocketOffsetStore,
+    SocketSubjectCache,
+)
 from .evaluator import HybridEvaluator
 from .store import PolicyStore, ResourceService
 from .service import AccessControlService
@@ -21,6 +32,12 @@ __all__ = [
     "HRScopeProvider",
     "IdentityClient",
     "StaticIdentityClient",
+    "GrpcIdentityClient",
+    "MockIdentityServer",
+    "BrokerServer",
+    "SocketEventBus",
+    "SocketOffsetStore",
+    "SocketSubjectCache",
     "HybridEvaluator",
     "PolicyStore",
     "ResourceService",
