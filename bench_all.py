@@ -23,6 +23,9 @@ benchmark (config 2); this file is the evidence matrix:
                          trees (depth 4-7): measures the kernel
                          eligibility rate under realistic org trees in
                          addition to throughput.
+7. ``wia-large``       — whatIsAllowed on a ~1000-rule tree: the
+                         device-assisted reverse query (ops/reverse.py)
+                         vs the scalar oracle.
 
 Every kernel config reports ``eligible_pct`` (fraction of the batch served
 on device; ineligible rows fall back to the scalar oracle).
