@@ -303,3 +303,55 @@ def test_prefilter_sharded_over_mesh():
     assert np.array_equal(d1, d2)
     assert np.array_equal(c1, c2)
     assert np.array_equal(s1, s2)
+
+
+def test_native_wire_path_through_prefiltered_kernel():
+    """The raw-bytes wire fast path (C++ encoder) composes with the
+    prefiltered kernel on trees above MIN_RULES: eligible rows served on
+    device, decisions equal to the oracle."""
+    from access_control_srv_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip(f"native encoder unavailable: {native.build_error()}")
+
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.transport_grpc import request_to_pb
+
+    doc, entities, actions = _stress_doc()  # ~720 rules, no conditions
+    urns = Urns()
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    ev = HybridEvaluator(engine)
+    assert ev.native_active and ev._kernel.active
+
+    def mk(i):
+        return Request(
+            target=Target(
+                subjects=[
+                    Attribute(id=urns["role"], value=f"role-{i % 23}"),
+                    Attribute(id=urns["subjectID"], value=f"u{i}"),
+                ],
+                resources=[Attribute(id=urns["entity"],
+                                     value=entities[i % len(entities)])],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=actions[i % len(actions)])],
+            ),
+            context={"resources": [],
+                     "subject": {"id": f"u{i}",
+                                 "role_associations": [
+                                     {"role": f"role-{i % 23}",
+                                      "attributes": []}],
+                                 "hierarchical_scopes": []}},
+        )
+
+    reqs = [mk(i) for i in range(24)]
+    messages = [request_to_pb(r).SerializeToString() for r in reqs]
+    out = ev.is_allowed_batch_wire(messages)
+    assert out is not None
+    batch, decision, cacheable, status = out
+    assert batch.eligible.all()
+    DEC = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    for b, req in enumerate(reqs):
+        assert decision[b] == DEC[engine.is_allowed(req).decision], b
