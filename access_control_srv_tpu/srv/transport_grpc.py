@@ -211,10 +211,15 @@ def reverse_query_to_pb(rq: ReverseQuery) -> pb.ReverseQuery:
 
 
 def _meta_to_dict(msg: pb.Meta) -> dict:
-    return {
+    out = {
         "owners": [_attr_dict(a) for a in msg.owners],
         "acls": [_attr_dict(a) for a in msg.acls],
     }
+    if msg.created:
+        out["created"] = msg.created
+    if msg.modified:
+        out["modified"] = msg.modified
+    return out
 
 
 def _attr_dict(msg: pb.Attribute) -> dict:
@@ -587,6 +592,8 @@ class GrpcServer:
             GrpcServer._fill_attr(msg.owners.add(), owner)
         for acl in doc.get("acls") or []:
             GrpcServer._fill_attr(msg.acls.add(), acl)
+        msg.created = float(doc.get("created") or 0.0)
+        msg.modified = float(doc.get("modified") or 0.0)
 
     @classmethod
     def _fill_rule(cls, msg: pb.Rule, doc: dict):

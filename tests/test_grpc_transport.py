@@ -137,3 +137,13 @@ def test_what_is_allowed_batch_over_wire(rig):
         single = client.what_is_allowed(wire_request(role=role))
         assert resp.responses[i].SerializeToString() == \
             single.SerializeToString()
+
+
+def test_meta_timestamps_over_wire(rig):
+    worker, client = rig
+    rule = pb.Rule(id="r_ts_wire", effect="PERMIT")
+    client.crud("rule", "Create", pb.RuleList(items=[rule]))
+    read = client.crud("rule", "Read", pb.ReadRequest(ids=["r_ts_wire"]),
+                       pb.RuleListResponse)
+    meta = read.items[0].meta
+    assert meta.created > 0 and meta.modified >= meta.created
