@@ -167,3 +167,96 @@ def test_hr_rendezvous_across_os_processes(broker):
     finally:
         responder.kill()
         responder.wait()
+
+
+def test_broker_survives_bad_frames_and_disconnects(broker):
+    """Malformed frames get an error reply; abrupt disconnects of RPC and
+    subscription connections leave the broker serving."""
+    import socket as socketlib
+
+    host, port = broker.address.rsplit(":", 1)
+    raw = socketlib.create_connection((host, int(port)))
+    raw.sendall(b"not json\n")
+    assert b"error" in raw.makefile("rb").readline()
+    raw.close()  # abrupt close mid-connection
+
+    sub = SocketEventBus(broker.address)
+    sub.topic("t_err").on(lambda e, m, c: None)
+    time.sleep(0.05)
+    sub.close()  # kills the subscription stream abruptly
+
+    bus = SocketEventBus(broker.address)
+    assert bus.topic("t_err").emit("still-alive", 1) == 0
+    assert bus.topic("t_err").read() == [("still-alive", 1)]
+    bus.close()
+
+
+def test_worker_serving_under_broker_and_hot_mutation(broker):
+    """Bounded soak: gRPC decision traffic races policy CRUD while the
+    worker runs on the cross-process broker backend — every response is
+    a valid old-tree/new-tree decision, never an error."""
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+    from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+    worker = Worker().start(
+        {
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+            "events": {"broker": {"address": broker.address}},
+        }
+    )
+    server = GrpcServer(worker, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    try:
+        import threading
+
+        from .utils import URNS as U
+
+        errors = []
+        stop = False
+
+        def msg():
+            m = pb.Request()
+            m.target.subjects.add(id=U["role"],
+                                  value="superadministrator-r-id")
+            m.target.resources.add(id=U["entity"], value=ORG)
+            m.target.actions.add(id=U["actionID"], value=U["read"])
+            m.context.subject.value = json.dumps({
+                "id": "root",
+                "role_associations": [
+                    {"role": "superadministrator-r-id", "attributes": []}
+                ],
+                "hierarchical_scopes": [],
+            }).encode()
+            return m
+
+        def serve():
+            while not stop:
+                resp = client.is_allowed(msg())
+                if resp.decision != pb.PERMIT:
+                    errors.append(resp)
+                    return
+
+        threads = [threading.Thread(target=serve) for _ in range(3)]
+        for t in threads:
+            t.start()
+        rules = worker.store.get_resource_service("rule")
+        for i in range(15):
+            rules.create([{"id": f"soak{i}", "name": f"soak{i}",
+                           "effect": "PERMIT",
+                           "target": {"subjects": [
+                               {"id": U["role"], "value": f"soak-role-{i}"}
+                           ]}}])
+            rules.delete(ids=[f"soak{i}"])
+        stop = True
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:1]
+    finally:
+        client.close()
+        server.stop()
+        worker.stop()
