@@ -171,3 +171,56 @@ def test_extended_fuzz_all_device_paths():
                 f"round {round_} request {b}: reverse query diverged"
             )
     assert total_eligible > 120  # the fuzz must exercise the device path
+
+
+CONDITIONS = [
+    "any(r.id == context.subject.id for r in (context.resources or []))",
+    "context.subject.id == 'ada'",
+    "len(context.resources or []) > 0",
+    "1 <= 2",
+    # raising condition: missing attribute -> DENY with error code+message
+    "context.subject.nonexistent_field == 1",
+]
+
+
+def _tree_with_conditions(rng: random.Random):
+    doc = _extended_tree(rng)
+    for ps in doc["policy_sets"]:
+        for pol in ps["policies"]:
+            for rule in pol.get("rules") or []:
+                if rng.random() < 0.25:
+                    rule["condition"] = rng.choice(CONDITIONS)
+    return doc
+
+
+def test_conditions_fuzz_through_evaluator():
+    """Randomized trees WITH conditions through the full evaluator batch
+    path: decisions, status codes AND operation_status messages (the
+    abort-message fast path) must equal the oracle for every row."""
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    rng = random.Random(31337)
+    checked = 0
+    for round_ in range(6):
+        doc = _tree_with_conditions(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        ev = HybridEvaluator(engine)
+        requests = _extended_requests(rng, 40)
+        expected = [engine.is_allowed(copy.deepcopy(r)) for r in requests]
+        responses = ev.is_allowed_batch([copy.deepcopy(r) for r in requests])
+        for b in range(len(requests)):
+            checked += 1
+            assert responses[b].decision == expected[b].decision, (
+                round_, b, responses[b].decision, expected[b].decision)
+            assert responses[b].operation_status.code == \
+                expected[b].operation_status.code, (round_, b)
+            assert responses[b].operation_status.message == \
+                expected[b].operation_status.message, (round_, b)
+            assert responses[b].evaluation_cacheable == \
+                expected[b].evaluation_cacheable, (round_, b)
+    assert checked >= 200
