@@ -39,6 +39,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    if args.addr is not None:
+        _, sep, port = args.addr.rpartition(":")
+        if not sep or not port.isdecimal() or not 0 <= int(port) <= 65535:
+            parser.error(
+                f"--addr must be HOST:PORT (e.g. 0.0.0.0:50061), "
+                f"got {args.addr!r}"
+            )
+
     stop_event = threading.Event()
 
     def request_stop(signum, frame):

@@ -53,7 +53,8 @@ class ReverseQueryKernel:
     query serves version-pinned from this snapshot, exactly like the
     decision kernel serves from its compiled arrays."""
 
-    def __init__(self, compiled: CompiledPolicies, policy_sets):
+    def __init__(self, compiled: CompiledPolicies, policy_sets,
+                 copy_tree: bool = True):
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
@@ -66,7 +67,10 @@ class ReverseQueryKernel:
             sets = [ps for ps in policy_sets.values() if ps is not None]
         else:
             sets = [ps for ps in policy_sets if ps is not None]
-        self.sets = copy.deepcopy(sets)
+        # copy_tree=False: the caller passes a tree that is already a
+        # version-pinned snapshot (the evaluator publishes one alongside
+        # the compiled arrays) — copying again would be pure waste
+        self.sets = copy.deepcopy(sets) if copy_tree else sets
         c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
 
         def run(batch_arrays, rgx_set, pfx_neq):

@@ -34,6 +34,11 @@ import threading
 from typing import Any, Callable, Optional
 
 
+# subscription-stream liveness: how often an idle stream emits a
+# heartbeat frame (and thereby notices a dead peer)
+HEARTBEAT_INTERVAL = 5.0
+
+
 def _send(wfile, obj: dict) -> None:
     wfile.write(json.dumps(obj).encode() + b"\n")
     wfile.flush()
@@ -153,10 +158,18 @@ class BrokerServer:
                     _send(handler.wfile, {"topic": topic, "event": event,
                                           "message": message,
                                           "offset": offset})
-            # live frames for offsets not covered by the replay
+            # live frames for offsets not covered by the replay.  The
+            # bounded get + heartbeat keeps dead subscriptions from pinning
+            # a thread + queue forever on idle topics: writing the
+            # heartbeat to a torn connection raises and the finally block
+            # reaps the queue
             replayed_to = len(log)
             while True:
-                frame = q.get()
+                try:
+                    frame = q.get(timeout=HEARTBEAT_INTERVAL)
+                except queue.Empty:
+                    _send(handler.wfile, {"hb": True})
+                    continue
                 if frame["offset"] < replayed_to and start is not None:
                     continue  # raced with the replay window
                 _send(handler.wfile, frame)
@@ -233,6 +246,8 @@ class SocketTopic:
             try:
                 for line in rfile:
                     frame = json.loads(line)
+                    if "hb" in frame:  # stream liveness probe, not an event
+                        continue
                     listener(
                         frame["event"], frame["message"],
                         {"offset": frame["offset"], "topic": self.name},
@@ -250,6 +265,14 @@ class SocketTopic:
 
     def close(self) -> None:
         for sock in self._streams:
+            # shutdown, not just close: the pump thread's makefile objects
+            # hold fd references (socket._io_refs), so close() alone never
+            # tears the connection — the broker would keep heartbeating a
+            # zombie stream and the pump thread would block forever
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:

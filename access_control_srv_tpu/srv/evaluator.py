@@ -16,6 +16,7 @@ serving on an XLA compile).
 
 from __future__ import annotations
 
+import copy
 import threading
 from typing import Optional
 
@@ -44,6 +45,7 @@ class HybridEvaluator:
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
         self._rq_kernel = None
+        self._tree_snapshot = None
         self._native_encoder = None
         self._lock = threading.Lock()
         self._compile_thread: Optional[threading.Thread] = None
@@ -62,8 +64,15 @@ class HybridEvaluator:
             version = self._version
 
         def compile_and_swap():
+            # snapshot FIRST, compile FROM the snapshot: the published
+            # (tree, arrays) pair is then consistent by construction — a
+            # hot mutation landing mid-compile bumps _version and this
+            # compile is dropped below, never pairing a mutated tree with
+            # stale index arrays (the reverse-query kernel assembles its
+            # trees from this snapshot)
+            tree_snapshot = copy.deepcopy(self.engine.policy_sets)
             compiled = compile_policies(
-                self.engine.policy_sets, self.engine.urns, version=version
+                tree_snapshot, self.engine.urns, version=version
             )
             kernel = None
             if compiled.supported and compiled.n_rules > 0:
@@ -79,6 +88,7 @@ class HybridEvaluator:
                     self._compiled = compiled
                     self._kernel = kernel
                     self._rq_kernel = None  # lazy: built on first wia batch
+                    self._tree_snapshot = tree_snapshot
                     self._native_encoder = native_encoder
             if self.logger and not compiled.supported:
                 self.logger.warning(
@@ -150,15 +160,18 @@ class HybridEvaluator:
         one device dispatch, tree/obligation assembly on host
         (ops/reverse.py); scalar oracle when no kernel is active.  The
         ReverseQueryKernel is built lazily on first use (deployments that
-        only serve isAllowed never pay its device transfer or the tree
-        snapshot copy)."""
+        only serve isAllowed never pay its device transfer)."""
         with self._lock:
+            # one consistent snapshot: kernel/compiled/tree always published
+            # together, so kernel != None implies compiled.supported
             compiled = self._compiled
+            kernel = self._kernel
             rq_kernel = self._rq_kernel
+            tree_snapshot = self._tree_snapshot
         if (
             self.backend == "oracle"
             or compiled is None
-            or self._kernel is None
+            or kernel is None
         ):
             self._count_path("oracle-wia", len(requests))
             return [self.engine.what_is_allowed(r) for r in requests]
@@ -166,16 +179,12 @@ class HybridEvaluator:
         from ..ops.reverse import ReverseQueryKernel, what_is_allowed_batch
 
         if rq_kernel is None or rq_kernel.compiled.version != compiled.version:
-            with self._lock:
-                current = self._version
-            if compiled.version != current:
-                # the tree moved on since this compile; building a snapshot
-                # from the live tree would pair mismatched node indices --
-                # serve this call from the oracle, the pending refresh will
-                # swap in a consistent kernel
-                self._count_path("oracle-wia", len(requests))
-                return [self.engine.what_is_allowed(r) for r in requests]
-            rq_kernel = ReverseQueryKernel(compiled, self.engine.policy_sets)
+            # tree_snapshot was published atomically with `compiled` and is
+            # the exact tree the arrays were compiled from — no tearing
+            # against concurrent hot mutations is possible here
+            rq_kernel = ReverseQueryKernel(
+                compiled, tree_snapshot, copy_tree=False
+            )
             with self._lock:
                 if self._compiled is compiled:
                     self._rq_kernel = rq_kernel

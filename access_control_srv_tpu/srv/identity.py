@@ -10,6 +10,8 @@ test/microservice_acs_enabled.spec.ts:106-223).
 
 from __future__ import annotations
 
+import copy
+import threading
 from typing import Any, Optional, Protocol
 
 
@@ -63,16 +65,28 @@ class GrpcIdentityClient:
             response_deserializer=pb.SubjectResponse.FromString,
         )
         # token -> resolved payload; evicted by the worker's userModified /
-        # auth-topic listeners exactly like the decision caches
+        # auth-topic listeners exactly like the decision caches.  gRPC
+        # handler threads hit this concurrently — all access goes through
+        # _cache_lock, and entries cross the boundary as copies so caller
+        # mutation can't corrupt future hits
         self._cache: dict[str, Any] = {}
         self._cache_size = cache_size
+        self._cache_lock = threading.Lock()
+        # bumped by evict(): an in-flight resolution that began before an
+        # eviction must not re-insert its (possibly stale) payload after
+        self._cache_gen = 0
 
     def find_by_token(self, token: str) -> Optional[dict]:
         import json
 
-        hit = self._cache.get(token)
+        with self._cache_lock:
+            hit = self._cache.get(token)
+            gen = self._cache_gen
         if hit is not None:
-            return hit
+            # copy outside the lock: hits must not serialize on copy cost,
+            # but the cached entry still needs isolation from caller
+            # mutation
+            return copy.deepcopy(hit)
         try:
             resp = self._call(
                 self._pb.FindByTokenRequest(token=token),
@@ -97,17 +111,26 @@ class GrpcIdentityClient:
                        "message": resp.status.message},
         }
         if payload is not None:
-            if len(self._cache) >= self._cache_size:
-                self._cache.pop(next(iter(self._cache)))
-            self._cache[token] = out
+            entry = copy.deepcopy(out)
+            with self._cache_lock:
+                if self._cache_gen == gen and self._cache_size > 0:
+                    while (self._cache
+                           and len(self._cache) >= self._cache_size):
+                        self._cache.pop(next(iter(self._cache)))
+                    self._cache[token] = entry
+                # else: an evict() landed while this resolution was in
+                # flight — the payload may predate the user mutation that
+                # triggered it, so it must not repopulate the cache
         return out
 
     def evict(self, token: str = None) -> None:
         """Drop cached resolutions (all, or one token) on user mutation."""
-        if token is None:
-            self._cache.clear()
-        else:
-            self._cache.pop(token, None)
+        with self._cache_lock:
+            self._cache_gen += 1
+            if token is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(token, None)
 
     def close(self) -> None:
         self.channel.close()

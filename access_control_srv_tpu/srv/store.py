@@ -234,18 +234,19 @@ class ResourceService:
             # timeStampFields meta.created/meta.modified,
             # cfg/config.json:324-331)
             meta["modified"] = now
-            if action == "CREATE" or not meta.get("created"):
-                existing_meta = (
-                    self.read_meta_data(item.get("id", ""))
-                    if item.get("id") else None
-                )
-                meta["created"] = (
-                    (existing_meta or {}).get("created") or now
-                )
+            # created is server-stamped: always restored from the stored
+            # document (a client-supplied meta.created must never overwrite
+            # the original creation time — reference resource-base
+            # timeStampFields semantics), falling back to now only when no
+            # prior doc exists
+            existing_meta = (
+                self.read_meta_data(item.get("id", ""))
+                if item.get("id") else None
+            )
+            meta["created"] = (existing_meta or {}).get("created") or now
             if action in ("MODIFY", "DELETE"):
-                existing = self.read_meta_data(item.get("id", ""))
-                if existing and existing.get("owners"):
-                    meta["owners"] = existing["owners"]
+                if existing_meta and existing_meta.get("owners"):
+                    meta["owners"] = existing_meta["owners"]
                     continue
             if not item.get("id"):
                 item["id"] = uuid.uuid4().hex

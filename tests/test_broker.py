@@ -260,3 +260,28 @@ def test_worker_serving_under_broker_and_hot_mutation(broker):
         client.close()
         server.stop()
         worker.stop()
+
+
+def test_dead_subscriber_reaped_on_idle_topic(broker):
+    """A subscriber that disconnects while its topic is idle must be
+    reaped by the stream heartbeat — not pinned in q.get() until the next
+    emit (dead queues+threads would otherwise accumulate forever)."""
+    import access_control_srv_tpu.srv.broker as brokermod
+    from access_control_srv_tpu.srv.broker import SocketEventBus
+
+    old = brokermod.HEARTBEAT_INTERVAL
+    brokermod.HEARTBEAT_INTERVAL = 0.2
+    try:
+        bus = SocketEventBus(broker.address)
+        bus.topic("idle-topic").on(lambda e, m, c: None)
+        deadline = time.time() + 5
+        while time.time() < deadline and not broker._subscribers.get("idle-topic"):
+            time.sleep(0.05)
+        assert len(broker._subscribers.get("idle-topic", [])) == 1
+        bus.close()  # shutdown() actually tears the stream connection
+        deadline = time.time() + 10
+        while time.time() < deadline and broker._subscribers.get("idle-topic"):
+            time.sleep(0.1)
+        assert not broker._subscribers.get("idle-topic")
+    finally:
+        brokermod.HEARTBEAT_INTERVAL = old

@@ -57,3 +57,16 @@ def test_main_broker_mode():
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=30)
     assert proc.returncode == 0
+
+
+def test_main_rejects_malformed_addr():
+    """--addr without a numeric port exits with a usage error instead of
+    an int() traceback."""
+    import pytest
+
+    from access_control_srv_tpu.__main__ import main
+
+    for bad in ("localhost", "127.0.0.1:", "host:port"):
+        with pytest.raises(SystemExit) as exc:
+            main(["--broker", "--addr", bad])
+        assert exc.value.code == 2

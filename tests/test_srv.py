@@ -634,5 +634,12 @@ def test_meta_timestamps_stamped_and_preserved():
         doc = rules.read({"ids": ["r_ts"]})["items"][0]["payload"]
         assert doc["meta"]["created"] == created  # preserved
         assert doc["meta"]["modified"] > first_modified
+        # a client-supplied meta.created on MODIFY must not overwrite the
+        # server-stamped creation time (resource-base timeStampFields are
+        # server-owned)
+        rules.update([{"id": "r_ts", "name": "ts3", "effect": "PERMIT",
+                       "meta": {"created": 1.0}}])
+        doc = rules.read({"ids": ["r_ts"]})["items"][0]["payload"]
+        assert doc["meta"]["created"] == created
     finally:
         w.stop()
