@@ -196,7 +196,14 @@ class PrefilteredKernel:
         self._runs: dict[tuple, object] = {}
         self.active = compiled.n_rules >= MIN_RULES
         if not self.active:
-            self._dense = DecisionKernel(compiled)
+            if mesh is not None:
+                # small trees delegate to the batch-sharded dense kernel so
+                # a configured mesh is honored on every tree size
+                from ..parallel.mesh import ShardedDecisionKernel
+
+                self._dense = ShardedDecisionKernel(compiled, mesh, axis)
+            else:
+                self._dense = DecisionKernel(compiled)
         self._c_inv = {
             k: jnp.asarray(v) for k, v in compiled.arrays.items()
             if not _is_varying(k)

@@ -35,12 +35,21 @@ class HybridEvaluator:
         logger=None,
         async_compile: bool = False,
         telemetry=None,
+        mesh=None,
+        mesh_axis: str = "data",
     ):
         self.engine = engine
         self.backend = backend
         self.logger = logger
         self.telemetry = telemetry
         self.async_compile = async_compile
+        # optional jax.sharding.Mesh: requests shard data-parallel over
+        # ``mesh_axis`` while policy tensors replicate — the serving-path
+        # multi-chip layout (the reference scales by running N stateless
+        # replicas behind a load balancer, src/worker.ts:161-198; here one
+        # process drives N chips)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self._version = 0
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
@@ -81,7 +90,9 @@ class HybridEvaluator:
                 # delegates to the dense kernel below MIN_RULES
                 from ..ops.prefilter import PrefilteredKernel
 
-                kernel = PrefilteredKernel(compiled)
+                kernel = PrefilteredKernel(
+                    compiled, mesh=self.mesh, axis=self.mesh_axis
+                )
             native_encoder = self._make_native_encoder(compiled, kernel)
             with self._lock:
                 if version >= self._version:  # drop stale compiles

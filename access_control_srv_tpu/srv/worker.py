@@ -54,6 +54,7 @@ class Worker:
         self.identity_client = None
         self.offset_store: Optional[OffsetStore] = None
         self.logger = None
+        self.mesh = None
 
     def start(
         self,
@@ -138,12 +139,50 @@ class Worker:
         adapter_cfg = cfg.get("adapter") or {}
         if adapter_cfg.get("graphql"):
             self.engine.create_resource_adapter(adapter_cfg)
+        # multi-chip serving: `parallel:data_devices` (int, or "all")
+        # builds a data-parallel mesh the evaluator shards request batches
+        # over; unset keeps single-device dispatch.  Touching jax.devices()
+        # initializes the backend, so the mesh is only built when asked for.
+        mesh = None
+        n_req = cfg.get("parallel:data_devices")
+        if n_req:
+            if isinstance(n_req, str):
+                n_req = n_req.strip().lower()
+            if n_req in ("all", "-1", -1):
+                n_req = -1
+            else:
+                try:
+                    n_req = int(n_req)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "parallel:data_devices must be a positive integer, "
+                        f"-1, or 'all'; got {n_req!r}"
+                    ) from None
+                if n_req <= 0:
+                    raise ValueError(
+                        "parallel:data_devices must be a positive integer, "
+                        f"-1, or 'all'; got {n_req!r}"
+                    )
+            import jax
+
+            from ..parallel import make_mesh
+
+            avail = len(jax.devices())
+            n = avail if n_req == -1 else min(n_req, avail)
+            mesh = make_mesh(n, axis=cfg.get("parallel:axis", "data"))
+            self.logger.info(
+                "data-parallel mesh active",
+                extra={"devices": n, "available": avail},
+            )
+        self.mesh = mesh
         self.evaluator = HybridEvaluator(
             self.engine,
             backend=cfg.get("evaluator:backend", "hybrid"),
             logger=self.logger,
             async_compile=bool(cfg.get("evaluator:async_compile", False)),
             telemetry=self.telemetry,
+            mesh=mesh,
+            mesh_axis=cfg.get("parallel:axis", "data"),
         )
 
         # policy store with self-authorization hook; the hook consults the

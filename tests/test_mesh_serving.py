@@ -1,0 +1,196 @@
+"""Multi-chip serving: the `parallel:data_devices` config key shards the
+serving path's request batches over a device mesh (here the 8 virtual CPU
+devices from conftest).  The reference scales horizontally with stateless
+replicas behind a load balancer (src/worker.ts:161-198); this is the
+TPU-native replacement — one worker, N chips, one sharded batch — proven
+through the product path (Worker -> evaluator -> kernel), not a bare
+kernel.
+"""
+
+import json
+import os
+
+import jax
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+from .utils import URNS
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+SEED = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data",
+    "seed_data",
+)
+
+
+def make_worker(data_devices):
+    return Worker().start(
+        {
+            "policies": {"type": "database"},
+            "parallel": {"data_devices": data_devices},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+        }
+    )
+
+
+def batch_requests(n):
+    reqs = []
+    from access_control_srv_tpu.models import Attribute, Request, Target
+
+    for i in range(n):
+        role = "superadministrator-r-id" if i % 2 == 0 else "ordinary-user"
+        reqs.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=URNS["role"], value=role),
+                        Attribute(id=URNS["subjectID"], value=f"u{i}"),
+                    ],
+                    resources=[
+                        Attribute(id=URNS["entity"], value=ORG),
+                        Attribute(id=URNS["resourceID"], value=f"r{i}"),
+                    ],
+                    actions=[
+                        Attribute(id=URNS["actionID"], value=URNS["read"])
+                    ],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"u{i}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def rig():
+    worker = make_worker(data_devices=8)
+    yield worker
+    worker.stop()
+
+
+def test_mesh_built_from_config(rig):
+    assert rig.mesh is not None
+    assert rig.mesh.devices.size == 8
+    assert rig.evaluator.mesh is rig.mesh
+    assert rig.evaluator.kernel_active
+
+
+def test_batch_decisions_match_oracle_on_mesh(rig):
+    reqs = batch_requests(24)
+    out = rig.evaluator.is_allowed_batch(reqs)
+    oracle = [rig.engine.is_allowed(r) for r in reqs]
+    assert [r.decision for r in out] == [r.decision for r in oracle]
+
+
+def test_mesh_survives_hot_mutation(rig):
+    """A CRUD-triggered recompile must rebuild the kernel WITH the mesh,
+    and a hot rule attached to a policy must flip the decision of a
+    previously-INDETERMINATE row through the mesh path."""
+    reqs = batch_requests(16)
+    before = rig.evaluator.is_allowed_batch(reqs)
+    assert before[1].decision == "INDETERMINATE"  # ordinary-user row
+
+    rule_service = rig.store.get_resource_service("rule")
+    rule_service.create(
+        [
+            {
+                "id": "mesh-hot-rule",
+                "name": "hot",
+                "effect": "PERMIT",
+                "target": {
+                    "subjects": [
+                        {"id": URNS["role"], "value": "ordinary-user"}
+                    ],
+                    "resources": [{"id": URNS["entity"], "value": ORG}],
+                    "actions": [],
+                },
+            }
+        ],
+        subject=None,
+    )
+    policy_service = rig.store.get_resource_service("policy")
+    doc = dict(policy_service.read()["items"][0]["payload"])
+    doc["rules"] = list(doc.get("rules") or []) + ["mesh-hot-rule"]
+    res = policy_service.update([doc], subject=None)
+    assert res["operation_status"]["code"] == 200, res
+
+    kernel = rig.evaluator._kernel
+    assert kernel is not None and kernel.mesh is rig.mesh
+    out = rig.evaluator.is_allowed_batch(reqs)
+    oracle = [rig.engine.is_allowed(r).decision for r in reqs]
+    assert [r.decision for r in out] == oracle
+    assert out[1].decision == "PERMIT"
+
+
+def test_all_keyword_uses_every_device():
+    worker = make_worker(data_devices="all")
+    try:
+        assert worker.mesh.devices.size == len(jax.devices())
+    finally:
+        worker.stop()
+
+
+def test_minus_one_string_means_all():
+    worker = make_worker(data_devices="-1")
+    try:
+        assert worker.mesh.devices.size == len(jax.devices())
+    finally:
+        worker.stop()
+
+
+def test_invalid_data_devices_rejected():
+    with pytest.raises(ValueError, match="parallel:data_devices"):
+        make_worker(data_devices="auto")
+    with pytest.raises(ValueError, match="parallel:data_devices"):
+        make_worker(data_devices=-2)
+
+
+def test_zero_data_devices_disables_mesh():
+    worker = make_worker(data_devices=0)
+    try:
+        assert worker.mesh is None
+    finally:
+        worker.stop()
+
+
+def test_grpc_batch_over_mesh(rig):
+    server = GrpcServer(rig, "127.0.0.1:0").start()
+    client = GrpcClient(server.addr)
+    try:
+        batch_msg = pb.BatchRequest()
+        for i in range(16):
+            role = "superadministrator-r-id" if i % 2 == 0 else "nobody"
+            msg = batch_msg.requests.add()
+            msg.target.subjects.add(id=URNS["role"], value=role)
+            msg.target.subjects.add(id=URNS["subjectID"], value=f"u{i}")
+            msg.target.resources.add(id=URNS["entity"], value=ORG)
+            msg.target.resources.add(id=URNS["resourceID"], value=f"r{i}")
+            msg.target.actions.add(id=URNS["actionID"], value=URNS["read"])
+            msg.context.subject.value = json.dumps(
+                {
+                    "id": f"u{i}",
+                    "role_associations": [{"role": role, "attributes": []}],
+                    "hierarchical_scopes": [],
+                }
+            ).encode()
+        resp = client.is_allowed_batch(batch_msg)
+        decisions = [r.decision for r in resp.responses]
+        assert decisions[0] == pb.Decision.Value("PERMIT")
+        assert decisions[1] == pb.Decision.Value("INDETERMINATE")
+    finally:
+        client.close()
+        server.stop()
