@@ -42,6 +42,11 @@ WIA_KEYS = [
     "maybe_mask_ex", "maybe_mask_rg",
 ]
 
+# below this rule count the scalar reverse-query walk beats the device
+# round-trip (measured: seed tree scalar ~6x kernel, ~1000-rule tree kernel
+# 3-12x scalar — bench_all.py wia rows); mirrors ops/prefilter.MIN_RULES
+REVERSE_MIN_RULES = 512
+
 
 class ReverseQueryKernel:
     """One jitted dispatch computing the whatIsAllowed match vectors for

@@ -171,7 +171,14 @@ class HybridEvaluator:
         one device dispatch, tree/obligation assembly on host
         (ops/reverse.py); scalar oracle when no kernel is active.  The
         ReverseQueryKernel is built lazily on first use (deployments that
-        only serve isAllowed never pay its device transfer)."""
+        only serve isAllowed never pay its device transfer).
+
+        Dispatch is adaptive like the decision path's MIN_RULES: on small
+        trees the scalar walk beats the device round-trip (measured ~6x on
+        the seed tree, bench_all.py wia row), so the kernel only engages at
+        REVERSE_MIN_RULES and above."""
+        from ..ops.reverse import REVERSE_MIN_RULES
+
         with self._lock:
             # one consistent snapshot: kernel/compiled/tree always published
             # together, so kernel != None implies compiled.supported
@@ -183,6 +190,7 @@ class HybridEvaluator:
             self.backend == "oracle"
             or compiled is None
             or kernel is None
+            or compiled.n_rules < REVERSE_MIN_RULES
         ):
             self._count_path("oracle-wia", len(requests))
             return [self.engine.what_is_allowed(r) for r in requests]

@@ -134,6 +134,10 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0) + by
 
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._values.get(key, 0)
+
     def snapshot(self) -> dict:
         with self._lock:
             return dict(self._values)

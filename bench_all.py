@@ -230,12 +230,30 @@ def bench_what_is_allowed():
     what_is_allowed_batch(engine, compiled, kernel, timed)
     kernel_qps = n / (time.perf_counter() - t0)
     batch = encode_requests(requests, compiled, skip_conditions=True)
+
+    # the PRODUCT path: HybridEvaluator's adaptive dispatch must choose the
+    # scalar walk on this small tree (REVERSE_MIN_RULES) — the served rate
+    # is the scalar rate, not the slower kernel round-trip
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.telemetry import Telemetry
+
+    telemetry = Telemetry()
+    evaluator = HybridEvaluator(engine, telemetry=telemetry)
+    timed = [copy.deepcopy(r) for r in requests]
+    t0 = time.perf_counter()
+    evaluator.what_is_allowed_batch(timed)
+    evaluator_qps = n / (time.perf_counter() - t0)
+    assert telemetry.paths.get("oracle-wia") == n, (
+        "adaptive wia dispatch must serve small trees from the scalar walk"
+    )
     return _result(
         "whatIsAllowed queries/sec (reverse query, 1k subjects)",
-        max(scalar_qps, kernel_qps),
+        evaluator_qps,
         "queries/s",
         {"n": n, "scalar_qps": round(scalar_qps, 1),
          "kernel_qps": round(kernel_qps, 1),
+         "evaluator_qps": round(evaluator_qps, 1),
+         "dispatch": "scalar",
          "eligible_pct": round(100.0 * float(batch.eligible.mean()), 1)},
     )
 
@@ -300,12 +318,25 @@ def bench_wia_large():
     t0 = time.perf_counter()
     what_is_allowed_batch(engine, compiled, kernel, timed)
     kernel_qps = n / (time.perf_counter() - t0)
+
+    # product-path dispatch check: on a >=REVERSE_MIN_RULES tree the
+    # evaluator must take the device-assisted path
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.telemetry import Telemetry
+
+    telemetry = Telemetry()
+    evaluator = HybridEvaluator(engine, telemetry=telemetry)
+    evaluator.what_is_allowed_batch([copy.deepcopy(r) for r in requests[:8]])
+    assert telemetry.paths.get("kernel-wia"), (
+        "adaptive wia dispatch must serve large trees from the kernel"
+    )
     return _result(
         f"whatIsAllowed queries/sec ({n_rules}-rule tree)",
         kernel_qps,
         "queries/s",
         {"n": n, "scalar_qps": round(scalar_qps, 1),
          "kernel_qps": round(kernel_qps, 1),
+         "dispatch": "kernel",
          "speedup_vs_scalar": round(kernel_qps / scalar_qps, 1)},
     )
 

@@ -111,13 +111,16 @@ def test_reverse_multi_set_tree():
         assert rq_shape(kernel_out[b]) == rq_shape(oracle_out[b]), b
 
 
-def test_evaluator_wia_batch_and_hot_mutation():
+def test_evaluator_wia_batch_and_hot_mutation(monkeypatch):
     """HybridEvaluator.what_is_allowed_batch serves device-assisted and
     stays consistent across a hot tree mutation (version-pinned snapshot;
-    stale compiles fall back to the oracle)."""
+    stale compiles fall back to the oracle).  The adaptive dispatch is
+    pinned to the kernel path (fixture trees sit under REVERSE_MIN_RULES)."""
     from access_control_srv_tpu.core.loader import load_policy_sets_from_file
+    from access_control_srv_tpu.ops import reverse as reverse_mod
     from access_control_srv_tpu.srv.evaluator import HybridEvaluator
 
+    monkeypatch.setattr(reverse_mod, "REVERSE_MIN_RULES", 0)
     engine = make_engine("policy_targets.yml")
     ev = HybridEvaluator(engine)
     requests = grid_requests(n=30, seed=311)
@@ -136,3 +139,27 @@ def test_evaluator_wia_batch_and_hot_mutation():
     batch_out2 = ev.what_is_allowed_batch([copy.deepcopy(r) for r in requests])
     for b in range(len(requests)):
         assert rq_shape(batch_out2[b]) == rq_shape(oracle_out2[b]), b
+
+
+def test_adaptive_wia_dispatch():
+    """Small trees serve the reverse query from the scalar walk (the
+    device round-trip loses below REVERSE_MIN_RULES — bench_all.py wia
+    row measured ~6x); the threshold routes to the kernel above it."""
+    from access_control_srv_tpu.ops.reverse import REVERSE_MIN_RULES
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.telemetry import Telemetry
+
+    engine = make_engine("policy_targets.yml")
+    telemetry = Telemetry()
+    ev = HybridEvaluator(engine, telemetry=telemetry)
+    compiled = ev._compiled
+    assert compiled is not None and compiled.n_rules < REVERSE_MIN_RULES
+
+    requests = grid_requests(n=12, seed=5)
+    oracle_out = [engine.what_is_allowed(copy.deepcopy(r)) for r in requests]
+    out = ev.what_is_allowed_batch([copy.deepcopy(r) for r in requests])
+    assert telemetry.paths.get("oracle-wia") == len(requests)
+    assert telemetry.paths.get("kernel-wia") == 0
+    assert ev._rq_kernel is None  # never built below the threshold
+    for b in range(len(requests)):
+        assert rq_shape(out[b]) == rq_shape(oracle_out[b]), b
