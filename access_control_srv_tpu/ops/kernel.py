@@ -293,8 +293,23 @@ def _acl_pass(c: dict, r: dict, with_acl: bool):
     return skip | (short == 1) | ((short == 0) & pair_ok)
 
 
+def _subject_ok(c: dict, r: dict):
+    """Subject matching per target row -> [T] bool (reference:
+    checkSubjectMatches, accessController.ts:793-823).  Shared by the
+    full matcher and the signature-bit kernel (whose stage-A resource/
+    action planes are precomputed per signature but whose subject side is
+    inherently per-request)."""
+    sub_pairs_ok = jax.vmap(
+        lambda ids, vals: _pairs_subset(ids, vals, r["r_sub_ids"], r["r_sub_vals"])
+    )(c["t_sub_ids"], c["t_sub_vals"])
+    role_ok = jax.vmap(lambda role: _member(role, r["r_roles"]))(c["t_role"])
+    return (c["t_n_subjects"] == 0) | jnp.where(
+        c["t_has_role"], role_ok, sub_pairs_ok
+    )
+
+
 def _match_targets(c: dict, r: dict, with_hr: bool = True,
-                   wia: bool = False):
+                   wia: bool = False, components: bool = False):
     """Stages A (target matching) + B (HR scopes) for one request: returns
     per-target-row match vectors the rule/policy stages gather from.
 
@@ -306,6 +321,16 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     for every row and hr_pass degenerates to all-ones); callers assert that
     tree property statically so XLA never materializes the owner-check
     tensors.
+
+    ``components=True`` returns the resource/action stage-A planes
+    (res_ex_p/res_ex_d/res_rg_p/res_rg_d/act_ok) WITHOUT the subject fold
+    — the signature-bit path precomputes exactly these per resource
+    signature (they depend only on the request's entity/operation/action
+    attributes, not its subject/context) and re-folds _subject_ok on
+    device per row.  The caller passes a property-free pseudo-request, so
+    the PERMIT property-fail reduces to has_props & entity-hit and the
+    DENY skip is vacuous (reference: :578-588, 644-647 with no request
+    properties).
 
     ``wia=True`` additionally emits the whatIsAllowed-mode match vectors
     (reference: accessController.ts:592-640 — PERMIT fails only when the
@@ -319,13 +344,7 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
 
     # ---------------------------------------------------------------- A: targets
     # subject matching (reference: checkSubjectMatches :793-823)
-    sub_pairs_ok = jax.vmap(
-        lambda ids, vals: _pairs_subset(ids, vals, r["r_sub_ids"], r["r_sub_vals"])
-    )(c["t_sub_ids"], c["t_sub_vals"])
-    role_ok = jax.vmap(lambda role: _member(role, r["r_roles"]))(c["t_role"])
-    sub_ok = (c["t_n_subjects"] == 0) | jnp.where(
-        c["t_has_role"], role_ok, sub_pairs_ok
-    )
+    sub_ok = _subject_ok(c, r)
 
     act_ok = jax.vmap(
         lambda ids, vals: _pairs_subset(ids, vals, r["r_act_ids"], r["r_act_vals"])
@@ -422,6 +441,15 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     res_ex_d = no_res | ((ent_any_ex | opm) & ~deny_skip_ex)
     res_rg_p = no_res | (state_final_rg & ~permit_fail_rg)
     res_rg_d = no_res | (state_final_rg & ~deny_skip_rg)
+
+    if components:
+        return {
+            "sig_res_ex_p": res_ex_p,
+            "sig_res_ex_d": res_ex_d,
+            "sig_res_rg_p": res_rg_p,
+            "sig_res_rg_d": res_rg_d,
+            "sig_act_ok": act_ok,
+        }
 
     base = sub_ok & act_ok
     tm_ex_p = base & res_ex_p
@@ -576,6 +604,13 @@ def _rule_predicates(c: dict, r: dict, m: dict, with_acl: bool = True):
     # the batch carries ACL pairs, the cheap no-pair formula otherwise
     acl_rule = ~c["rule_has_target"] | gather_t(_acl_pass(c, r, with_acl), rt)
 
+    has_cond, cond_t, cond_a, cond_c = _rule_conditions(c, r)
+    return reached, acl_rule, has_cond, cond_t, cond_a, cond_c
+
+
+def _rule_conditions(c: dict, r: dict):
+    """Per-rule condition wiring: host-evaluated predicate bits joined to
+    the rule mask (reference: conditionMatches eval, utils.ts:47-56)."""
     has_cond = c["rule_cond"] >= 0
     cond_idx = jnp.clip(c["rule_cond"], 0, None)
     if r["cond_true"].shape[0] > 0:
@@ -586,25 +621,36 @@ def _rule_predicates(c: dict, r: dict, m: dict, with_acl: bool = True):
         cond_t = jnp.ones_like(cond_idx, dtype=bool)
         cond_a = jnp.zeros_like(cond_idx, dtype=bool)
         cond_c = jnp.full_like(cond_idx, 200)
-    return reached, acl_rule, has_cond, cond_t, cond_a, cond_c
+    return has_cond, cond_t, cond_a, cond_c
 
 
-def _policy_gates(c: dict, r: dict, m: dict):
-    """Stage D: set-level exact match, carried policyEffect, multi-entity
-    recheck and the policy/set gates (reference: accessController.ts
-    :130-195, 429-463); shared by both kernels."""
-    tm_ex_p, tm_ex_d = m["tm_ex_p"], m["tm_ex_d"]
-    tm_rg_p, tm_rg_d = m["tm_rg_p"], m["tm_rg_d"]
-    hr_pass = m["hr_pass"]
-    ent_valid = r["r_ent_valid"]  # [NR]
+def _multi_entity_ok(c: dict, r_ent_vals, r_ent_valid):
+    """Multi-entity recheck -> [S] (reference: accessController.ts
+    :429-463): every requested entity must exactly match some policy's
+    resources; PERMIT policies with properties never match a bare entity
+    attribute.  Shared by the full kernel (request entities) and the
+    signature planes builder (the signature IS the entity list)."""
+    pol_ent_hit = (
+        (c["pol_ent_vals"][:, :, :, None] == r_ent_vals[None, None, None, :])
+        & (c["pol_ent_vals"][:, :, :, None] >= 0)
+        & r_ent_valid[None, None, None, :]
+    ).any(axis=2)  # [S, KP, NR]
+    pol_multi_ok = pol_ent_hit & ~(
+        (c["pol_effect"] == 1) & c["pol_has_props"]
+    )[:, :, None] & c["pol_valid"][:, :, None]
+    return jnp.all(~r_ent_valid[None, :] | pol_multi_ok.any(axis=1), axis=1)
 
-    def gather_t(table, idx):
-        return jnp.take(table, idx, axis=0)
 
-    # first loop: per-policy carried effect (compile-time pol_eff_ctx)
-    pt = c["pol_target"]
+def _policy_gates_core(c: dict, pp_ex_p, pp_ex_d, pp_rg_p, pp_rg_d,
+                       multi_gate):
+    """First/second policy loop on pre-gathered policy-row match planes
+    ([S, KP], full target match incl. subject fold): carried policyEffect
+    selection, exact-match break, and the policy gate (reference:
+    accessController.ts:130-195).  Shared by the full kernel (planes
+    gathered from [T] match vectors) and the signature kernel (planes
+    precomputed per signature, subject side folded by the caller)."""
     ctx_deny = c["pol_eff_ctx"] == 2
-    pol_tm_first = jnp.where(ctx_deny, gather_t(tm_ex_d, pt), gather_t(tm_ex_p, pt))
+    pol_tm_first = jnp.where(ctx_deny, pp_ex_d, pp_ex_p)
     pol_tm_first = pol_tm_first & c["pol_valid"] & c["pol_has_target"]  # [S, KP]
     KP = pol_tm_first.shape[1]
     kp_pos = jnp.arange(KP)
@@ -621,26 +667,36 @@ def _policy_gates(c: dict, r: dict, m: dict):
         c["pol_eff_ctx"], eff_src_kp[:, None], axis=1
     )[:, 0]  # [S] carried policyEffect after the break (reference: :130-157)
 
-    # multi-entity recheck (reference: :429-463): every requested entity must
-    # exactly match some policy's resources; PERMIT policies with properties
-    # never match a bare entity attribute
-    pol_ent_hit = (
-        (c["pol_ent_vals"][:, :, :, None] == r["r_ent_vals"][None, None, None, :])
-        & (c["pol_ent_vals"][:, :, :, None] >= 0)
-        & ent_valid[None, None, None, :]
-    ).any(axis=2)  # [S, KP, NR]
-    pol_multi_ok = pol_ent_hit & ~(
-        (c["pol_effect"] == 1) & c["pol_has_props"]
-    )[:, :, None] & c["pol_valid"][:, :, None]
-    multi_ok = jnp.all(~ent_valid[None, :] | pol_multi_ok.any(axis=1), axis=1)  # [S]
-    exact = exact0 & jnp.where(r["r_n_entity_attrs"] > 1, multi_ok, True)
+    exact = exact0 & multi_gate
 
     # second loop: policy gate with the frozen carried effect
     eval_deny = (eval_eff == 2)[:, None]
-    pol_tm_ex = jnp.where(eval_deny, gather_t(tm_ex_d, pt), gather_t(tm_ex_p, pt))
-    pol_tm_rg = jnp.where(eval_deny, gather_t(tm_rg_d, pt), gather_t(tm_rg_p, pt))
+    pol_tm_ex = jnp.where(eval_deny, pp_ex_d, pp_ex_p)
+    pol_tm_rg = jnp.where(eval_deny, pp_rg_d, pp_rg_p)
     pol_gate = ~c["pol_has_target"] | jnp.where(exact[:, None], pol_tm_ex, pol_tm_rg)
-    pol_gate = pol_gate & c["pol_valid"]
+    return pol_gate & c["pol_valid"]
+
+
+def _policy_gates(c: dict, r: dict, m: dict):
+    """Stage D: set-level exact match, carried policyEffect, multi-entity
+    recheck and the policy/set gates (reference: accessController.ts
+    :130-195, 429-463); shared by both kernels."""
+    tm_ex_p, tm_ex_d = m["tm_ex_p"], m["tm_ex_d"]
+    tm_rg_p, tm_rg_d = m["tm_rg_p"], m["tm_rg_d"]
+    hr_pass = m["hr_pass"]
+
+    def gather_t(table, idx):
+        return jnp.take(table, idx, axis=0)
+
+    pt = c["pol_target"]
+    multi_ok = _multi_entity_ok(c, r["r_ent_vals"], r["r_ent_valid"])
+    multi_gate = jnp.where(r["r_n_entity_attrs"] > 1, multi_ok, True)
+    pol_gate = _policy_gates_core(
+        c,
+        gather_t(tm_ex_p, pt), gather_t(tm_ex_d, pt),
+        gather_t(tm_rg_p, pt), gather_t(tm_rg_d, pt),
+        multi_gate,
+    )
 
     # set gate: exact mode only, PERMIT variant (reference: :131-134)
     set_gate = ~c["set_has_target"] | gather_t(tm_ex_p, c["set_target"])
@@ -705,11 +761,29 @@ def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
     decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
     """
     m = _match_targets(c, r, with_hr)
+    return _evaluate_from_matches(c, r, m, with_acl)
+
+
+def _evaluate_from_matches(c: dict, r: dict, m: dict, with_acl: bool = True):
+    """Stages C-G given the stage-A/B match vectors ``m``: rule
+    reachability, policy/set gates, combining, aborts.  Shared by the full
+    kernel (m from _match_targets) and the signature-bit kernel (m rebuilt
+    from precomputed per-signature planes + the per-row subject fold)."""
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(
         c, r, m, with_acl
     )
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
+    return _combine_and_decide(
+        c, reached, acl_rule, has_cond, cond_t, cond_a, cond_c,
+        pol_gate, set_gate, pol_subject,
+    )
 
+
+def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
+                        cond_a, cond_c, pol_gate, set_gate, pol_subject):
+    """Stages E-G: rule-effect combination per policy, policy-effect
+    combination per set, last-set-wins decision and condition aborts —
+    shared tail of every kernel variant."""
     # -------------------------------------------------- E: combine rule effects
     scope = set_gate[:, None, None] & pol_gate[:, :, None]
     abort_rule = reached & has_cond & cond_a & scope

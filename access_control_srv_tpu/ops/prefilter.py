@@ -46,12 +46,30 @@ from .compile import CompiledPolicies
 from .encode import RequestBatch
 from .kernel import (
     DecisionKernel,
+    _action_kind,
+    _combine_and_decide,
     _evaluate_one,
+    _match_targets,
+    _multi_entity_ok,
+    _policy_gates_core,
+    _rule_conditions,
     lead_padding,
     pad_cols,
     pow2_bucket,
     tree_needs_hr,
 )
+
+# varying arrays the signature runner gathers per row (stage E-G inputs);
+# everything stage-A/target-table-shaped is folded into the per-signature
+# rule/policy-level planes instead (_sig_planes_for)
+_SIG_C_KEYS = [
+    "rule_valid", "rule_effect", "rule_cacheable_raw", "rule_cacheable_eff",
+    "rule_has_target", "rule_cond",
+]
+_SIG_R_KEYS = [
+    "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
+    "r_n_entity_attrs", "r_n_ra", "r_acl_short",
+]
 
 _RULE_FIELDS = [
     "rule_valid", "rule_effect", "rule_cacheable_raw", "rule_cacheable_eff",
@@ -192,8 +210,19 @@ class PrefilteredKernel:
         self.axis = axis
         self._subs: dict[tuple, CompiledPolicies] = {}
         self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
+        self._bits: dict[tuple, dict[str, jnp.ndarray]] = {}
+        self._bits_fn = None
         self._dense: DecisionKernel | None = None
         self._runs: dict[tuple, object] = {}
+        # signature-bit fast path: stage A's resource/action planes depend
+        # only on the (entity, operation, action) signature the batch is
+        # already grouped by, so they are precomputed once per signature
+        # and the per-row device work collapses to the subject fold plus
+        # the rule/policy stages.  Sound only when stage B is trivial for
+        # the whole tree (no row carries subjects + scoping entity) and the
+        # batch has no ACL pairs / request properties (those rows need the
+        # full per-row matcher).
+        self.sig_ok = not tree_needs_hr(compiled.arrays)
         self.active = compiled.n_rules >= MIN_RULES
         if not self.active:
             if mesh is not None:
@@ -248,6 +277,253 @@ class PrefilteredKernel:
             self._runs[key] = run
         return run
 
+    def _sig_runner(self, schedule: tuple):
+        """The signature-plane kernel: stage A (resource/action target
+        matching) is pre-gathered to rule/policy/set granularity per
+        signature (_planes_for), so the per-row device work is pure
+        elementwise — subject folds against [KP, KR]-shaped planes plus
+        stages C-G — with NO per-row gathers (the [B, T]-at-[S,KP,KR]
+        gathers were the dominant cost on TPU: ~44ms each per batch).
+
+        ``schedule`` describes the packed per-row int32 buffer: every
+        request array + the transposed condition bits travel in ONE
+        host->device transfer (the TPU tunnel pays per-transfer latency —
+        ~35 small puts per call were costing ~10x the compute), and the
+        three outputs return stacked as one [3, B] readback."""
+        key = ("sig", schedule)
+        run = self._runs.get(key)
+        if run is None:
+            c_inv = self._c_inv
+
+            def sub_fold(r, n_sub, has_role, role, sub_ids, sub_vals):
+                # checkSubjectMatches at plane granularity (reference:
+                # accessController.ts:793-823); broadcasts over the
+                # plane's leading shape
+                role_ok = (
+                    (role[..., None] == r["r_roles"]) & (r["r_roles"] >= 0)
+                ).any(-1)
+                eq = (
+                    (sub_ids[..., :, None] == r["r_sub_ids"])
+                    & (sub_vals[..., :, None] == r["r_sub_vals"])
+                    & (r["r_sub_ids"] >= 0)
+                )
+                pairs_ok = ((sub_ids < 0) | eq.any(-1)).all(-1)
+                return (n_sub == 0) | jnp.where(has_role, role_ok, pairs_ok)
+
+            def run(cs, planes, mega):
+                def one(row):
+                    offset = 0
+                    ra = {}
+                    for k, w, tail in schedule:
+                        v = row[offset:offset + w]
+                        offset += w
+                        ra[k] = v.reshape(tail) if tail else v[0]
+                    g = ra.pop("__g__")
+                    c = {**c_inv,
+                         **jax.tree_util.tree_map(lambda x: x[g], cs)}
+                    sg = jax.tree_util.tree_map(lambda x: x[g], planes)
+                    rr = {
+                        **ra,
+                        "cond_true": ra["cond_true"] != 0,
+                        "cond_abort": ra["cond_abort"] != 0,
+                        "cond_code": ra["cond_code"],
+                    }
+
+                    rl_sub = sub_fold(rr, sg["rl_n_sub"], sg["rl_has_role"],
+                                      sg["rl_role"], sg["rl_sub_ids"],
+                                      sg["rl_sub_vals"])  # [S, KP, KR]
+                    pl_sub = sub_fold(rr, sg["pl_n_sub"], sg["pl_has_role"],
+                                      sg["pl_role"], sg["pl_sub_ids"],
+                                      sg["pl_sub_vals"])  # [S, KP]
+                    sl_sub = sub_fold(rr, sg["sl_n_sub"], sg["sl_has_role"],
+                                      sg["sl_role"], sg["sl_sub_ids"],
+                                      sg["sl_sub_vals"])  # [S]
+
+                    tm_rule = ~c["rule_has_target"] | (
+                        rl_sub & (sg["rl_ex"] | sg["rl_rg"])
+                    )
+                    reached = c["rule_valid"] & tm_rule
+                    kind = _action_kind(c, rr)
+                    short = rr["r_acl_short"]
+                    acl_row = sg["rl_skip"] | (short == 1) | (
+                        (short == 0) & (rr["r_n_ra"] > 0) & (kind > 0)
+                    )
+                    acl_rule = ~c["rule_has_target"] | acl_row
+                    has_cond, cond_t, cond_a, cond_c = _rule_conditions(c, rr)
+
+                    # policy gates via the shared core (reference:
+                    # accessController.ts:130-195): subject fold
+                    # distributes over the deny/permit plane selection
+                    multi_gate = jnp.where(
+                        rr["r_n_entity_attrs"] > 1, sg["multi_ok"], True
+                    )
+                    pol_gate = _policy_gates_core(
+                        c,
+                        sg["pp_ex_p"] & pl_sub, sg["pp_ex_d"] & pl_sub,
+                        sg["pp_rg_p"] & pl_sub, sg["pp_rg_d"] & pl_sub,
+                        multi_gate,
+                    )
+                    set_gate = (
+                        ~c["set_has_target"] | (sg["ss_ex_p"] & sl_sub)
+                    ) & c["set_valid"]
+                    pol_subject = jnp.ones_like(pol_gate)
+
+                    return _combine_and_decide(
+                        c, reached, acl_rule, has_cond, cond_t, cond_a,
+                        cond_c, pol_gate, set_gate, pol_subject,
+                    )
+
+                return jnp.stack(jax.vmap(one)(mega))
+
+            if self.mesh is None:
+                run = jax.jit(run)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self.mesh, P())
+                data = NamedSharding(self.mesh, P(self.axis))
+                out = NamedSharding(self.mesh, P(None, self.axis))
+                run = jax.jit(
+                    run,
+                    in_shardings=(repl, repl, data),
+                    out_shardings=out,
+                )
+            self._runs[key] = run
+        return run
+
+    def _planes_for(self, keys: tuple, groups: list[dict], stacked,
+                    widths: tuple, rgx_np, pfx_np):
+        """Per-signature stage-A planes pre-gathered to rule/policy/set
+        granularity ([G, S, KP, KR] / [G, S, KP] / [G, S]), cached
+        alongside the stack.  Computed in ONE vmapped dispatch of the
+        components-mode matcher over per-group pseudo-requests (the
+        signature's entities/operations/actions, no subjects/properties)
+        against the stacked target tables — regex outcomes are
+        deterministic per (vocab row, entity value), so the planes are
+        batch-independent.  The expensive [S,KP,KR]-at-target-table
+        gathers happen HERE, once per signature set, never per row."""
+        bits = self._bits.pop(keys, None)
+        if bits is None:
+            NR, NOP, NACT = widths
+            G = len(groups)
+            W = rgx_np.shape[0]
+            p_ent = np.full((G, NR), -1, np.int32)
+            p_ent_e = np.zeros((G, NR), np.int32)
+            p_ent_valid = np.zeros((G, NR), bool)
+            p_ops = np.full((G, NOP), -1, np.int32)
+            p_act_ids = np.full((G, NACT), -1, np.int32)
+            p_act_vals = np.full((G, NACT), -1, np.int32)
+            p_rgx = np.zeros((G, W, max(NR, 1)), bool)
+            p_pfx = np.zeros((G, W, max(NR, 1)), bool)
+            for g, info in enumerate(groups):
+                ents = info["ordered_ents"]
+                cols = info["ordered_cols"]
+                for j, (e, col) in enumerate(zip(ents, cols)):
+                    p_ent[g, j] = e
+                    p_ent_e[g, j] = j
+                    if e >= 0:
+                        p_ent_valid[g, j] = True
+                        p_rgx[g, :, j] = rgx_np[:, col]
+                        p_pfx[g, :, j] = pfx_np[:, col]
+                ops = info["op_ids"]
+                p_ops[g, : len(ops)] = ops
+                pairs = info["act_pairs"]
+                for j, (aid, aval) in enumerate(pairs):
+                    p_act_ids[g, j] = aid
+                    p_act_vals[g, j] = aval
+            neg1 = np.full((G, 1), -1, np.int32)
+            pseudo = {
+                "r_ent_vals": p_ent,
+                "r_ent_e": p_ent_e,
+                "r_ent_valid": p_ent_valid,
+                "r_op_vals": p_ops,
+                "r_act_ids": p_act_ids,
+                "r_act_vals": p_act_vals,
+                "r_sub_ids": neg1,
+                "r_sub_vals": neg1,
+                "r_roles": neg1,
+                "r_prop_vals": neg1,
+                "r_prop_sfx": neg1,
+                "r_prop_run": neg1,
+                "r_prop_tail": neg1,
+                "r_has_props": np.zeros((G,), bool),
+                "rgx_set": p_rgx,
+                "pfx_neq": p_pfx,
+            }
+            if self._bits_fn is None:
+                c_inv = self._c_inv
+
+                def bits_fn(cs, rr):
+                    def one(g, r_row):
+                        c = {**c_inv,
+                             **jax.tree_util.tree_map(lambda x: x[g], cs)}
+                        comp = _match_targets(
+                            c, r_row, with_hr=False, components=True
+                        )
+                        act = comp["sig_act_ok"]
+                        rt = c["rule_target"]
+                        pt = c["pol_target"]
+                        st = c["set_target"]
+                        deny = c["rule_effect"] == 2
+
+                        def g_(tab, idx):
+                            return jnp.take(tab, idx, axis=0)
+
+                        # multi-entity recheck is signature-determined
+                        # (reference: :429-463); pseudo ents ARE the sig
+                        multi_ok = _multi_entity_ok(
+                            c, r_row["r_ent_vals"], r_row["r_ent_valid"]
+                        )
+                        return {
+                            "rl_ex": jnp.where(
+                                deny, g_(comp["sig_res_ex_d"], rt),
+                                g_(comp["sig_res_ex_p"], rt)
+                            ) & g_(act, rt),
+                            "rl_rg": jnp.where(
+                                deny, g_(comp["sig_res_rg_d"], rt),
+                                g_(comp["sig_res_rg_p"], rt)
+                            ) & g_(act, rt),
+                            "rl_role": g_(c["t_role"], rt),
+                            "rl_has_role": g_(c["t_has_role"], rt),
+                            "rl_n_sub": g_(c["t_n_subjects"], rt),
+                            "rl_sub_ids": g_(c["t_sub_ids"], rt),
+                            "rl_sub_vals": g_(c["t_sub_vals"], rt),
+                            "rl_skip": g_(c["t_skip_acl"], rt),
+                            "pp_ex_p": g_(comp["sig_res_ex_p"], pt) & g_(act, pt),
+                            "pp_ex_d": g_(comp["sig_res_ex_d"], pt) & g_(act, pt),
+                            "pp_rg_p": g_(comp["sig_res_rg_p"], pt) & g_(act, pt),
+                            "pp_rg_d": g_(comp["sig_res_rg_d"], pt) & g_(act, pt),
+                            "pl_role": g_(c["t_role"], pt),
+                            "pl_has_role": g_(c["t_has_role"], pt),
+                            "pl_n_sub": g_(c["t_n_subjects"], pt),
+                            "pl_sub_ids": g_(c["t_sub_ids"], pt),
+                            "pl_sub_vals": g_(c["t_sub_vals"], pt),
+                            "ss_ex_p": g_(comp["sig_res_ex_p"], st) & g_(act, st),
+                            "sl_role": g_(c["t_role"], st),
+                            "sl_has_role": g_(c["t_has_role"], st),
+                            "sl_n_sub": g_(c["t_n_subjects"], st),
+                            "sl_sub_ids": g_(c["t_sub_ids"], st),
+                            "sl_sub_vals": g_(c["t_sub_vals"], st),
+                            "multi_ok": multi_ok,
+                        }
+
+                    G = rr["r_ent_vals"].shape[0]
+                    return jax.vmap(one)(jnp.arange(G), rr)
+
+                self._bits_fn = jax.jit(bits_fn)
+            varying = {k: v for k, v in stacked.items()}
+            bits = jax.tree_util.tree_map(
+                jnp.asarray,
+                self._bits_fn(
+                    varying,
+                    {k: jnp.asarray(v) for k, v in pseudo.items()},
+                ),
+            )
+            if len(self._bits) >= 16:
+                self._bits.pop(next(iter(self._bits)))
+        self._bits[keys] = bits
+        return bits
+
     # ---------------------------------------------------------------- caches
     def _sub(self, key, ent_ids, ent_cols, op_ids, act_vals,
              rgx_set) -> CompiledPolicies:
@@ -288,39 +564,97 @@ class PrefilteredKernel:
 
         ents = np.asarray(batch.arrays["r_ent_vals"])  # [B, NR]
         cols = np.asarray(batch.arrays["r_ent_e"])     # [B, NR]
+        valid = np.asarray(batch.arrays["r_ent_valid"])
         ops = np.asarray(batch.arrays["r_op_vals"])    # [B, NOP]
+        act_ids = np.asarray(batch.arrays["r_act_ids"])
         acts = np.asarray(batch.arrays["r_act_vals"])  # [B, NACT]
         B, NR = ents.shape
         NOP = ops.shape[1]
+        NACT = acts.shape[1]
 
-        sig = np.concatenate(
-            [np.sort(ents, 1), np.sort(ops, 1), np.sort(acts, 1)], axis=1
+        # signature-bit eligibility: trivial stage B tree-wide, and no ACL
+        # pairs / request properties in this batch (see __init__)
+        use_sig = (
+            self.sig_ok
+            and not bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any())
+            and not bool(np.asarray(batch.arrays["r_has_props"]).any())
         )
+
+        # sig path: group rows by ORDERED entity runs (the sticky/
+        # prefix-reset state machines are order-sensitive) + sorted ops +
+        # sorted action (id, val) pairs.  Fallback path: stage A runs per
+        # row anyway, so the coarser order-insensitive signature maximizes
+        # group sharing (permuted multi-entity requests share one group).
+        ents_m = np.where(valid, ents, -1)
+        pair_key = (act_ids.astype(np.int64) << 32) | (
+            acts.astype(np.int64) & 0xFFFFFFFF
+        )
+        order = np.argsort(pair_key, axis=1, kind="stable")
+        act_ids_s = np.take_along_axis(act_ids, order, 1)
+        act_vals_s = np.take_along_axis(acts, order, 1)
+        if use_sig:
+            sig = np.concatenate(
+                [ents_m, np.sort(ops, 1), act_ids_s, act_vals_s], axis=1
+            )
+        else:
+            sig = np.concatenate(
+                [np.sort(ents_m, 1), np.sort(ops, 1), np.sort(acts, 1)],
+                axis=1,
+            )
         uniq, inv = np.unique(sig, axis=0, return_inverse=True)
 
         # entity value id -> batch entity column (positional in the runs)
-        valid = ents >= 0
         id_to_col = dict(zip(ents[valid].tolist(), cols[valid].tolist()))
 
         rgx_np = np.asarray(batch.rgx_set)
+        pfx_np = np.asarray(batch.pfx_neq)
         keys = []
+        groups = []
         subs = []  # held directly: cache eviction cannot orphan this batch
         for g in range(uniq.shape[0]):
             sig_row = uniq[g]
-            ent_ids = np.unique(sig_row[:NR][sig_row[:NR] >= 0])
-            op_ids = np.unique(sig_row[NR:NR + NOP][sig_row[NR:NR + NOP] >= 0])
-            act_vals = np.unique(
-                sig_row[NR + NOP:][sig_row[NR + NOP:] >= 0]
+            ordered = sig_row[:NR]
+            ent_ids = np.unique(ordered[ordered >= 0])
+            op_row = sig_row[NR:NR + NOP]
+            op_ids = np.unique(op_row[op_row >= 0])
+            if use_sig:
+                aid_row = sig_row[NR + NOP:NR + NOP + NACT]
+                aval_row = sig_row[NR + NOP + NACT:]
+            else:
+                aid_row = np.full((0,), -1, sig_row.dtype)
+                aval_row = sig_row[NR + NOP:]
+            pair_valid = (aid_row >= 0) | (
+                aval_row[: aid_row.shape[0]] >= 0
             )
+            act_vals = np.unique(aval_row[aval_row >= 0])
             ent_cols = np.array(
                 [id_to_col[int(e)] for e in ent_ids], np.int64
             )
-            key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
-                   tuple(act_vals.tolist()), self.compiled.version)
+            # compaction cache key stays sorted (order-insensitive rule
+            # candidacy -> permuted signatures share one compacted subtree)
+            sub_key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
+                       tuple(act_vals.tolist()), self.compiled.version)
             subs.append(
-                self._sub(key, ent_ids, ent_cols, op_ids, act_vals, rgx_np)
+                self._sub(sub_key, ent_ids, ent_cols, op_ids, act_vals,
+                          rgx_np)
             )
-            keys.append(key)
+            if use_sig:
+                keys.append((tuple(ordered.tolist()),
+                             tuple(op_ids.tolist()),
+                             tuple(aid_row[pair_valid].tolist()),
+                             tuple(aval_row[pair_valid].tolist()),
+                             self.compiled.version))
+                groups.append({
+                    "ordered_ents": ordered.tolist(),
+                    "ordered_cols": [
+                        id_to_col.get(int(e), 0) for e in ordered
+                    ],
+                    "op_ids": op_ids,
+                    "act_pairs": list(zip(aid_row[pair_valid].tolist(),
+                                          aval_row[pair_valid].tolist())),
+                })
+            else:
+                keys.append(sub_key)
         stacked = self._stack(tuple(keys), subs)
 
         _, bucket, e_bucket, pad_lead = lead_padding(batch)
@@ -340,6 +674,35 @@ class PrefilteredKernel:
                 return np.concatenate([a, fill], axis=0)
 
         g_idx = pad_lead(inv.astype(np.int32).reshape(B))
+        if use_sig:
+            bits = self._planes_for(
+                tuple(keys), groups, stacked, (NR, NOP, NACT),
+                rgx_np, pfx_np,
+            )
+            # pack the whole per-row side into ONE int32 transfer
+            schedule = [("__g__", 1, ())]
+            parts = [g_idx.astype(np.int32)[:, None]]
+            for k in _SIG_R_KEYS:
+                a = pad_lead(np.asarray(batch.arrays[k]))
+                tail = a.shape[1:]
+                w = int(np.prod(tail)) if tail else 1
+                parts.append(a.reshape(a.shape[0], w).astype(np.int32))
+                schedule.append((k, w, tuple(tail)))
+            C = batch.cond_true.shape[0]
+            for nm, arr in (("cond_true", batch.cond_true),
+                            ("cond_abort", batch.cond_abort),
+                            ("cond_code", batch.cond_code)):
+                parts.append(
+                    np.ascontiguousarray(
+                        pad_cols(arr, parts[0].shape[0]).T
+                    ).astype(np.int32)
+                )
+                schedule.append((nm, C, (C,)))
+            mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
+            run = self._sig_runner(tuple(schedule))
+            cs = {k: v for k, v in stacked.items() if k in _SIG_C_KEYS}
+            out = np.asarray(run(cs, bits, jnp.asarray(mega)))
+            return tuple(out[i][:B] for i in range(3))
         run = self._runner(
             bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),
             tree_needs_hr(stacked),

@@ -1,0 +1,266 @@
+"""Signature-bit kernel differential: the prefiltered kernel's fast path
+precomputes stage A's resource/action planes per resource signature
+(ops/prefilter.py _bits_for) and folds only the subject side per row.
+Decisions must be bit-identical to the scalar oracle and the dense kernel
+on every eligible shape: exact + regex entities (foreign-namespace prefix
+resets), multi-entity ordered runs, operations, conditions and aborts,
+all three combining algorithms.
+
+Eligibility (use_sig): the tree has no HR-bearing target rows and the
+batch carries no ACL pairs / request properties; anything else must fall
+back to the full per-row matcher with identical results.
+"""
+
+import copy
+import random
+
+import numpy as np
+
+from access_control_srv_tpu.core import AccessController
+from access_control_srv_tpu.core.loader import load_policy_sets
+from access_control_srv_tpu.ops import (
+    DecisionKernel,
+    PrefilteredKernel,
+    compile_policies,
+    encode_requests,
+)
+
+from .test_kernel_differential import (
+    ACTIONS,
+    DEC_CODE,
+    ENTITIES,
+    ROLES,
+    SUBJECTS,
+    _random_policy_tree,
+)
+from .test_fuzz_extended import FOREIGN
+from .test_prefilter import force_active
+from .utils import URNS, build_request
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+
+
+def _strip_scoping(doc):
+    """Remove role-scoping attributes so the tree is HR-trivial
+    (tree_needs_hr False -> sig path eligible)."""
+    drop = {URNS["roleScopingEntity"], URNS["hierarchicalRoleScoping"]}
+    for ps in doc["policy_sets"]:
+        for node in [ps] + list(ps.get("policies") or []):
+            for rule in [node] + list(node.get("rules") or []):
+                tgt = rule.get("target")
+                if tgt and tgt.get("subjects"):
+                    tgt["subjects"] = [
+                        a for a in tgt["subjects"] if a["id"] not in drop
+                    ]
+    return doc
+
+
+def _sig_tree(rng):
+    doc = _strip_scoping(_random_policy_tree(rng))
+    # swap some entities to foreign namespaces: regex prefix resets
+    for ps in doc["policy_sets"]:
+        for pol in ps.get("policies") or []:
+            for node in [pol] + list(pol.get("rules") or []):
+                tgt = node.get("target") or {}
+                for attr in tgt.get("resources") or []:
+                    if attr["id"] == URNS["entity"] and rng.random() < 0.3:
+                        attr["value"] = rng.choice(FOREIGN)
+    return doc
+
+
+def _sig_requests(rng, n):
+    """Prop-free, ACL-free requests: single and multi-entity (ordered runs
+    matter for the sticky state machines), operations, all actions."""
+    out = []
+    pool = ENTITIES + FOREIGN
+    for i in range(n):
+        action = rng.choice(ACTIONS)
+        if action == URNS["execute"]:
+            rtype = rng.choice(["mutation.runPipeline", "mutation.other"])
+            rid = rtype
+        elif rng.random() < 0.4:
+            k = rng.randint(2, 3)
+            rtype = rng.sample(pool, k)
+            rid = [f"id-{j}" for j in range(k)]
+        else:
+            rtype = rng.choice(pool)
+            rid = "id-0"
+        out.append(
+            build_request(
+                subject_id=rng.choice(SUBJECTS),
+                subject_role=rng.choice(ROLES + ["other-role"]),
+                resource_type=rtype,
+                resource_id=rid,
+                action_type=action,
+            )
+        )
+    return out
+
+
+def _run_differential(engine, compiled, kern, requests):
+    batch = encode_requests(requests, compiled)
+    dec, cach, status = kern.evaluate(batch)
+    n_checked = 0
+    for b, req in enumerate(requests):
+        if not batch.eligible[b] or status[b] != 200:
+            continue
+        expected = engine.is_allowed(copy.deepcopy(req))
+        assert dec[b] == DEC_CODE[expected.decision], (
+            b, dec[b], expected.decision
+        )
+        n_checked += 1
+    return n_checked, batch
+
+
+def test_sig_path_engages_and_matches_oracle():
+    rng = random.Random(1234)
+    total = 0
+    trees_with_sig = 0
+    for round_i in range(12):
+        doc = _sig_tree(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        kern = force_active(PrefilteredKernel(compiled))
+        if not kern.sig_ok:
+            continue
+        trees_with_sig += 1
+        requests = _sig_requests(rng, 64)
+        n, batch = _run_differential(engine, compiled, kern, requests)
+        total += n
+        # prop/ACL-free batch on an HR-trivial tree MUST take the sig path
+        assert kern._bits, "signature planes were never built"
+        assert any(
+            isinstance(k, tuple) and k and k[0] == "sig"
+            for k in kern._runs
+        ), "sig runner never compiled"
+    assert trees_with_sig >= 8
+    assert total > 300
+
+
+def test_sig_path_matches_dense_kernel_exactly():
+    rng = random.Random(77)
+    for _ in range(4):
+        doc = _sig_tree(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        dense = DecisionKernel(compiled)
+        kern = force_active(PrefilteredKernel(compiled))
+        if not kern.sig_ok:
+            continue
+        requests = _sig_requests(rng, 96)
+        batch = encode_requests(requests, compiled)
+        d1, c1, s1 = dense.evaluate(batch)
+        d2, c2, s2 = kern.evaluate(batch)
+        el = np.asarray(batch.eligible)
+        assert (d1[el] == d2[el]).all()
+        assert (c1[el] == c2[el]).all()
+        assert (s1[el] == s2[el]).all()
+
+
+def test_prop_rows_fall_back_with_identical_results():
+    """A single prop-bearing request disables the sig path for the batch;
+    decisions stay oracle-identical either way."""
+    rng = random.Random(9)
+    doc = _sig_tree(rng)
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    if not compiled.supported:
+        return
+    kern = force_active(PrefilteredKernel(compiled))
+    requests = _sig_requests(rng, 16)
+    requests.append(
+        build_request(
+            subject_id=SUBJECTS[0],
+            subject_role=ROLES[0],
+            resource_type=ENTITIES[0],
+            resource_id="id-p",
+            action_type=URNS["read"],
+            resource_property=["urn:restorecommerce:acs:model:location.Location#name"],
+        )
+    )
+    n_bits_before = len(kern._bits)
+    n, batch = _run_differential(engine, compiled, kern, requests)
+    assert bool(np.asarray(batch.arrays["r_has_props"]).any())
+    # fallback: no new signature planes were built for this batch
+    assert len(kern._bits) == n_bits_before
+
+
+def test_hr_tree_disables_sig_path():
+    engine = AccessController()
+    from .utils import fixture
+    from access_control_srv_tpu.core import populate
+
+    populate(engine, fixture("role_scopes.yml"))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    kern = PrefilteredKernel(compiled)
+    assert not kern.sig_ok
+
+
+def test_conditions_and_aborts_through_sig_path():
+    """Condition-bearing rules (true/false/abort) evaluate through the sig
+    runner with exact codes."""
+    from .utils import fixture
+    from access_control_srv_tpu.core import populate
+
+    engine = AccessController()
+    populate(engine, fixture("conditions.yml"))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    kern = force_active(PrefilteredKernel(compiled))
+    assert kern.sig_ok, "conditions fixture must stay HR-trivial"
+    rng = random.Random(3)
+    requests = _sig_requests(rng, 48)
+    # guaranteed abort row: matches r_self_modify's target but its context
+    # lacks `subject`, so the condition raises -> DENY + error code
+    # (reference: accessController.ts:259-270)
+    from access_control_srv_tpu.models import Attribute, Request, Target
+
+    USER = "urn:restorecommerce:acs:model:user.User"
+    requests.append(
+        Request(
+            target=Target(
+                subjects=[Attribute(id=URNS["role"], value="member")],
+                resources=[Attribute(id=URNS["entity"], value=USER)],
+                actions=[
+                    Attribute(id=URNS["actionID"], value=URNS["modify"])
+                ],
+            ),
+            context={
+                "resources": [{"id": "someone-else"}],
+                "subject": {
+                    "role_associations": [
+                        {"role": "member", "attributes": []}
+                    ],
+                    "hierarchical_scopes": [],
+                },
+            },
+        )
+    )
+    batch = encode_requests(requests, compiled)
+    dec, cach, status = kern.evaluate(batch)
+    assert kern._bits
+    n_aborts = 0
+    for b, req in enumerate(requests):
+        if not batch.eligible[b]:
+            continue
+        expected = engine.is_allowed(copy.deepcopy(req))
+        if status[b] != 200:
+            assert expected.operation_status.code == status[b]
+            assert dec[b] == DEC_CODE["DENY"]
+            n_aborts += 1
+        else:
+            assert dec[b] == DEC_CODE[expected.decision]
+    # the abort wiring must actually be exercised, or this test proves
+    # nothing about it
+    assert n_aborts > 0
