@@ -195,11 +195,22 @@ class PrefilteredKernel:
     MIN_RULES rules skip the machinery entirely."""
 
     def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024,
-                 mesh=None, axis: str = "data"):
+                 mesh=None, axis: str = "data", max_groups: int = 512,
+                 telemetry=None):
         """``mesh``: optional jax.sharding.Mesh — requests shard
         data-parallel over ``axis`` while the stacked subtrees and regex
         matrices replicate (the multi-chip layout of parallel/mesh.py
-        applied to the candidate-compacted dispatch)."""
+        applied to the candidate-compacted dispatch).
+
+        ``max_groups``: cardinality guard — a batch whose rows span more
+        than this many distinct resource signatures is split into
+        group-bounded segments evaluated separately, so adversarial
+        traffic (every request a novel entity set) degrades to more
+        dispatches instead of unbounded stack memory ([G, ...] device
+        arrays scale with G).
+
+        ``telemetry``: optional srv.telemetry.Telemetry; counts signature
+        compaction/stack cache hits and misses and guard splits."""
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
@@ -208,6 +219,8 @@ class PrefilteredKernel:
         self.cache_size = cache_size
         self.mesh = mesh
         self.axis = axis
+        self.max_groups = max_groups
+        self.telemetry = telemetry
         self._subs: dict[tuple, CompiledPolicies] = {}
         self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
         self._bits: dict[tuple, dict[str, jnp.ndarray]] = {}
@@ -525,16 +538,23 @@ class PrefilteredKernel:
         return bits
 
     # ---------------------------------------------------------------- caches
+    def _count(self, key: str, n: int = 1) -> None:
+        if self.telemetry is not None and n:
+            self.telemetry.paths.inc(key, n)
+
     def _sub(self, key, ent_ids, ent_cols, op_ids, act_vals,
              rgx_set) -> CompiledPolicies:
         sub = self._subs.pop(key, None)  # LRU: reinsert at the tail
         if sub is None:
+            self._count("prefilter-sub-miss")
             rows = candidate_rows(
                 self.compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
             )
             sub = compact_rules(self.compiled, rows)
             if len(self._subs) >= self.cache_size:
                 self._subs.pop(next(iter(self._subs)))
+        else:
+            self._count("prefilter-sub-hit")
         self._subs[key] = sub
         return sub
 
@@ -543,6 +563,7 @@ class PrefilteredKernel:
     ) -> dict[str, jnp.ndarray]:
         stacked = self._stacks.pop(keys, None)
         if stacked is None:
+            self._count("prefilter-stack-miss")
             krp = pow2_bucket(max(s.KR for s in subs), floor=4)
             tp = pow2_bucket(max(s.T for s in subs), floor=8)
             stacked = {
@@ -554,6 +575,8 @@ class PrefilteredKernel:
             }
             if len(self._stacks) >= 16:
                 self._stacks.pop(next(iter(self._stacks)))
+        else:
+            self._count("prefilter-stack-hit")
         self._stacks[keys] = stacked
         return stacked
 
@@ -602,6 +625,45 @@ class PrefilteredKernel:
                 axis=1,
             )
         uniq, inv = np.unique(sig, axis=0, return_inverse=True)
+        inv = inv.reshape(B)
+
+        if uniq.shape[0] > self.max_groups:
+            # cardinality guard: segment the batch so each dispatch spans
+            # at most max_groups signatures — adversarial all-novel-
+            # signature traffic degrades to more dispatches instead of
+            # unbounded [G, ...] stack memory
+            self._count("prefilter-guard-splits")
+            row_order = np.argsort(inv, kind="stable")
+            seg_slices = []
+            start = 0
+            seen = 0
+            last_group = -1
+            for pos, gidx in enumerate(inv[row_order].tolist()):
+                if gidx != last_group:
+                    seen += 1
+                    last_group = gidx
+                    if seen > self.max_groups:
+                        seg_slices.append(row_order[start:pos])
+                        start = pos
+                        seen = 1
+            seg_slices.append(row_order[start:])
+            out = [np.zeros((B,), np.int32) for _ in range(3)]
+            for idx in seg_slices:
+                sub_batch = RequestBatch(
+                    B=len(idx),
+                    arrays={k: np.ascontiguousarray(np.asarray(v)[idx])
+                            for k, v in batch.arrays.items()},
+                    rgx_set=batch.rgx_set,
+                    pfx_neq=batch.pfx_neq,
+                    cond_true=np.ascontiguousarray(batch.cond_true[:, idx]),
+                    cond_abort=np.ascontiguousarray(batch.cond_abort[:, idx]),
+                    cond_code=np.ascontiguousarray(batch.cond_code[:, idx]),
+                    eligible=np.asarray(batch.eligible)[idx],
+                )
+                seg_out = self.evaluate(sub_batch)
+                for o, s in zip(out, seg_out):
+                    o[idx] = s
+            return tuple(out)
 
         # entity value id -> batch entity column (positional in the runs)
         id_to_col = dict(zip(ents[valid].tolist(), cols[valid].tolist()))

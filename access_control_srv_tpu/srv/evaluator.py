@@ -91,7 +91,8 @@ class HybridEvaluator:
                 from ..ops.prefilter import PrefilteredKernel
 
                 kernel = PrefilteredKernel(
-                    compiled, mesh=self.mesh, axis=self.mesh_axis
+                    compiled, mesh=self.mesh, axis=self.mesh_axis,
+                    telemetry=self.telemetry,
                 )
             native_encoder = self._make_native_encoder(compiled, kernel)
             with self._lock:
