@@ -37,6 +37,16 @@ def main(argv: list[str] | None = None) -> int:
         "--broker", action="store_true",
         help="serve the cross-process event/cache broker instead of a worker",
     )
+    parser.add_argument(
+        "--broker-data-dir", default=None,
+        help="broker durability: journal directory (topics/offsets/KV "
+             "survive restarts)",
+    )
+    parser.add_argument(
+        "--broker-secret", default=os.environ.get("ACS_BROKER_SECRET"),
+        help="broker auth: shared secret required from every connection "
+             "(also via ACS_BROKER_SECRET)",
+    )
     args = parser.parse_args(argv)
 
     if args.addr is not None:
@@ -59,7 +69,11 @@ def main(argv: list[str] | None = None) -> int:
         from .srv.broker import BrokerServer
 
         host, _, port = (args.addr or "127.0.0.1:0").rpartition(":")
-        broker = BrokerServer(host or "127.0.0.1", int(port)).start()
+        broker = BrokerServer(
+            host or "127.0.0.1", int(port),
+            data_dir=args.broker_data_dir,
+            secret=args.broker_secret,
+        ).start()
         print(f"broker listening on {broker.address}", flush=True)
         stop_event.wait()
         broker.stop()

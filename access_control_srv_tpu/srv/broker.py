@@ -26,7 +26,9 @@ Protocol (one JSON object per line):
 
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import queue
 import socket
 import socketserver
@@ -45,18 +47,43 @@ def _send(wfile, obj: dict) -> None:
 
 
 class BrokerServer:
-    """Topic logs + shared KV + consumer offsets behind one TCP port."""
+    """Topic logs + shared KV + consumer offsets behind one TCP port.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``data_dir`` enables durability: every mutation is appended to a
+    JSON-lines journal and replayed on construction, so topic logs,
+    consumer offsets and the KV store survive broker restarts — the role
+    Kafka's commit log and Redis persistence play for the reference
+    (src/worker.ts:123,354-361: offsets resumed per topic at subscribe).
+    The journal is append-only; it is flushed per record but not fsynced
+    (a broker-process crash loses nothing already flushed; only a
+    host-level crash can drop the tail).
+
+    ``secret`` enables authentication: the first frame of every
+    connection must be {"op": "auth", "secret": ...} or the connection is
+    refused — the deployed-Kafka/Redis auth the reference inherits from
+    its infrastructure."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None,
+                 secret: Optional[str] = None):
         self._topics: dict[str, list[tuple[str, Any]]] = {}
         self._kv: dict[str, Any] = {}
         self._consumer_offsets: dict[str, int] = {}
         self._subscribers: dict[str, list[queue.Queue]] = {}
         self._lock = threading.Lock()
+        self.secret = secret
+        self._journal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            path = os.path.join(data_dir, "broker.journal")
+            if os.path.exists(path):
+                self._replay_journal(path)
+            self._journal = open(path, "a", encoding="utf-8")
         broker = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                authed = broker.secret is None
                 for line in self.rfile:
                     line = line.strip()
                     if not line:
@@ -66,6 +93,15 @@ class BrokerServer:
                     except ValueError:
                         _send(self.wfile, {"error": "bad frame"})
                         continue
+                    if not authed:
+                        if cmd.get("op") == "auth" and hmac.compare_digest(
+                            str(cmd.get("secret") or ""), broker.secret
+                        ):
+                            authed = True
+                            _send(self.wfile, {"ok": True})
+                            continue
+                        _send(self.wfile, {"error": "auth required"})
+                        return
                     if cmd.get("op") == "subscribe":
                         broker._serve_subscription(self, cmd)
                         return  # connection now belongs to the stream
@@ -92,6 +128,44 @@ class BrokerServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+
+    # ----------------------------------------------------------- durability
+    def _replay_journal(self, path: str) -> None:
+        """Rebuild topics / KV / consumer offsets from the journal; a torn
+        trailing record (crash mid-append) is skipped."""
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail record
+                kind = rec.get("k")
+                if kind == "emit":
+                    self._topics.setdefault(rec["t"], []).append(
+                        (rec["e"], rec.get("m"))
+                    )
+                elif kind == "set":
+                    self._kv[rec["key"]] = rec.get("v")
+                elif kind == "evict":
+                    for key in [
+                        k for k in self._kv if k.startswith(rec["p"])
+                    ]:
+                        del self._kv[key]
+                elif kind == "co":
+                    self._consumer_offsets[rec["t"]] = rec["o"]
+
+    def _log(self, rec: dict) -> None:
+        """Append one journal record; caller holds self._lock."""
+        if self._journal is not None:
+            self._journal.write(json.dumps(rec) + "\n")
+            self._journal.flush()
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, cmd: dict) -> dict:
@@ -103,6 +177,8 @@ class BrokerServer:
                 log = self._topics.setdefault(topic, [])
                 log.append((event, message))
                 offset = len(log) - 1
+                self._log({"k": "emit", "t": topic, "e": event,
+                           "m": message})
                 subs = list(self._subscribers.get(topic, []))
             frame = {"topic": topic, "event": event,
                      "message": message, "offset": offset}
@@ -120,6 +196,8 @@ class BrokerServer:
         if op == "set":
             with self._lock:
                 self._kv[cmd["key"]] = cmd.get("value")
+                self._log({"k": "set", "key": cmd["key"],
+                           "v": cmd.get("value")})
             return {"ok": True}
         if op == "get":
             with self._lock:
@@ -133,10 +211,14 @@ class BrokerServer:
                 keys = [k for k in self._kv if k.startswith(cmd["prefix"])]
                 for k in keys:
                     del self._kv[k]
+                if keys:
+                    self._log({"k": "evict", "p": cmd["prefix"]})
             return {"evicted": len(keys)}
         if op == "offset_commit":
             with self._lock:
                 self._consumer_offsets[cmd["topic"]] = cmd["offset"]
+                self._log({"k": "co", "t": cmd["topic"],
+                           "o": cmd["offset"]})
             return {"ok": True}
         if op == "offset_get":
             with self._lock:
@@ -185,12 +267,19 @@ class BrokerServer:
 class _Rpc:
     """One request/response connection, serialized by a lock."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, secret: Optional[str] = None):
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=30)
         self._rfile = self._sock.makefile("rb")
         self._wfile = self._sock.makefile("wb")
         self._lock = threading.Lock()
+        if secret is not None:
+            resp = self.call({"op": "auth", "secret": secret})
+            if not resp.get("ok"):
+                self.close()
+                raise ConnectionError(
+                    f"broker auth failed: {resp.get('error', 'rejected')}"
+                )
 
     def call(self, obj: dict) -> dict:
         with self._lock:
@@ -198,7 +287,12 @@ class _Rpc:
             line = self._rfile.readline()
         if not line:
             raise ConnectionError("broker connection closed")
-        return json.loads(line)
+        resp = json.loads(line)
+        if resp.get("error") == "auth required":
+            raise ConnectionError(
+                "broker auth required: configure events:broker:secret"
+            )
+        return resp
 
     def close(self) -> None:
         try:
@@ -210,10 +304,12 @@ class _Rpc:
 class SocketTopic:
     """Topic interface (srv/events.py) backed by the broker."""
 
-    def __init__(self, name: str, address: str, rpc: _Rpc):
+    def __init__(self, name: str, address: str, rpc: _Rpc,
+                 secret: Optional[str] = None):
         self.name = name
         self._address = address
         self._rpc = rpc
+        self._secret = secret
         self._streams: list[socket.socket] = []
 
     @property
@@ -238,6 +334,12 @@ class SocketTopic:
         sock = socket.create_connection((host, int(port)))
         wfile = sock.makefile("wb")
         rfile = sock.makefile("rb")
+        if self._secret is not None:
+            _send(wfile, {"op": "auth", "secret": self._secret})
+            resp = json.loads(rfile.readline() or b"{}")
+            if not resp.get("ok"):
+                sock.close()
+                raise ConnectionError("broker auth failed for subscription")
         _send(wfile, {"op": "subscribe", "topic": self.name,
                       "from": starting_offset})
         self._streams.append(sock)
@@ -282,16 +384,19 @@ class SocketTopic:
 class SocketEventBus:
     """EventBus interface (srv/events.py) backed by a broker process."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, secret: Optional[str] = None):
         self.address = address
-        self._rpc = _Rpc(address)
+        self._secret = secret
+        self._rpc = _Rpc(address, secret=secret)
         self._topics: dict[str, SocketTopic] = {}
         self._lock = threading.Lock()
 
     def topic(self, name: str) -> SocketTopic:
         with self._lock:
             if name not in self._topics:
-                self._topics[name] = SocketTopic(name, self.address, self._rpc)
+                self._topics[name] = SocketTopic(
+                    name, self.address, self._rpc, secret=self._secret
+                )
             return self._topics[name]
 
     def topics(self) -> dict[str, SocketTopic]:
@@ -308,8 +413,8 @@ class SocketSubjectCache:
     the shared-Redis role: every worker process sees the same subject /
     HR-scope entries."""
 
-    def __init__(self, address: str):
-        self._rpc = _Rpc(address)
+    def __init__(self, address: str, secret: Optional[str] = None):
+        self._rpc = _Rpc(address, secret=secret)
 
     def get(self, key: str) -> Any:
         return self._rpc.call({"op": "get", "key": key})["value"]
@@ -333,8 +438,8 @@ class SocketOffsetStore:
     """OffsetStore interface (srv/events.py) on the broker (the chassis
     Redis DB-0 role)."""
 
-    def __init__(self, address: str):
-        self._rpc = _Rpc(address)
+    def __init__(self, address: str, secret: Optional[str] = None):
+        self._rpc = _Rpc(address, secret=secret)
 
     def commit(self, topic: str, offset: int) -> None:
         self._rpc.call(

@@ -91,9 +91,14 @@ class Worker:
                 SocketSubjectCache,
             )
 
-            self.bus = SocketEventBus(broker_address)
-            self.offset_store = SocketOffsetStore(broker_address)
-            self.subject_cache = SocketSubjectCache(broker_address)
+            broker_secret = cfg.get("events:broker:secret")
+            self.bus = SocketEventBus(broker_address, secret=broker_secret)
+            self.offset_store = SocketOffsetStore(
+                broker_address, secret=broker_secret
+            )
+            self.subject_cache = SocketSubjectCache(
+                broker_address, secret=broker_secret
+            )
         else:
             self.bus = EventBus()
             self.offset_store = OffsetStore()

@@ -285,3 +285,127 @@ def test_dead_subscriber_reaped_on_idle_topic(broker):
         assert not broker._subscribers.get("idle-topic")
     finally:
         brokermod.HEARTBEAT_INTERVAL = old
+
+
+def test_kill_and_restart_resumes_from_journal(tmp_path):
+    """Durability: a broker restarted on the same data_dir replays its
+    journal — topic logs, committed consumer offsets and the KV store all
+    survive, and a subscriber resuming from its committed offset sees
+    exactly the uncommitted tail (reference: offsets resumed per topic at
+    subscribe, src/worker.ts:123,354-361)."""
+    data_dir = str(tmp_path / "broker-data")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        topic = bus.topic("durable.topic")
+        for i in range(5):
+            topic.emit("thing", {"i": i})
+        offsets = SocketOffsetStore(server.address)
+        offsets.commit("durable.topic", 3)
+        cache = SocketSubjectCache(server.address)
+        cache.set("cache:u1:subject", {"id": "u1"})
+        cache.set("cache:gone:subject", {"id": "gone"})
+        cache.evict_prefix("cache:gone")
+        bus.close(); offsets.close(); cache.close()
+    finally:
+        server.stop()
+
+    # cold restart on the same journal (fresh port)
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server2.address)
+        topic = bus.topic("durable.topic")
+        assert topic.offset == 5
+        assert [m["i"] for _, m in topic.read(0)] == [0, 1, 2, 3, 4]
+        offsets = SocketOffsetStore(server2.address)
+        assert offsets.get("durable.topic") == 3
+        cache = SocketSubjectCache(server2.address)
+        assert cache.get("cache:u1:subject") == {"id": "u1"}
+        assert not cache.exists("cache:gone:subject")
+
+        # resume from the committed offset: replay 3..4, then live
+        got = []
+        topic.on(lambda e, m, ctx: got.append((m["i"], ctx["offset"])),
+                 starting_offset=offsets.get("durable.topic"))
+        topic.emit("thing", {"i": 5})
+        deadline = time.time() + 5
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [(3, 3), (4, 4), (5, 5)]
+        bus.close(); offsets.close(); cache.close()
+    finally:
+        server2.stop()
+
+
+def test_journal_skips_torn_tail(tmp_path):
+    data_dir = str(tmp_path / "broker-data")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        bus.topic("t").emit("a", {"n": 1})
+        bus.close()
+    finally:
+        server.stop()
+    # simulate a crash mid-append
+    with open(os.path.join(data_dir, "broker.journal"), "a") as fh:
+        fh.write('{"k": "emit", "t": "t", "e": "b"')
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server2.address)
+        assert bus.topic("t").read(0) == [("a", {"n": 1})]
+        bus.close()
+    finally:
+        server2.stop()
+
+
+def test_broker_auth_rejects_and_accepts():
+    server = BrokerServer(secret="hunter2").start()
+    try:
+        unauthed = SocketSubjectCache(server.address)  # no secret
+        with pytest.raises(ConnectionError, match="auth"):
+            unauthed.get("k")
+        with pytest.raises(ConnectionError, match="auth"):
+            SocketSubjectCache(server.address, secret="wrong")
+        cache = SocketSubjectCache(server.address, secret="hunter2")
+        cache.set("k", 1)
+        assert cache.get("k") == 1
+        cache.close()
+
+        bus = SocketEventBus(server.address, secret="hunter2")
+        topic = bus.topic("authed.topic")
+        got = []
+        topic.on(lambda e, m, ctx: got.append(m))
+        topic.emit("ev", {"x": 1})
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [{"x": 1}]
+        bus.close()
+    finally:
+        server.stop()
+
+
+def test_worker_config_passes_broker_secret(tmp_path):
+    server = BrokerServer(secret="s3cr3t").start()
+    try:
+        worker = Worker().start(
+            {
+                "events": {"broker": {"address": server.address,
+                                      "secret": "s3cr3t"}},
+                "policies": {"type": "database"},
+            }
+        )
+        worker.bus.topic("x").emit("ping", {"ok": True})
+        assert worker.bus.topic("x").read(0) == [("ping", {"ok": True})]
+        worker.stop()
+        # and a wrong secret fails fast at startup
+        with pytest.raises(ConnectionError, match="auth"):
+            Worker().start(
+                {
+                    "events": {"broker": {"address": server.address,
+                                          "secret": "nope"}},
+                    "policies": {"type": "database"},
+                }
+            )
+    finally:
+        server.stop()
