@@ -28,21 +28,70 @@ from ..models.model import Decision
 
 
 class Collection:
-    """An ordered id -> document map with optional JSON snapshotting."""
+    """An ordered id -> document map with optional durable persistence.
 
-    def __init__(self, name: str, snapshot_dir: Optional[str] = None):
+    Persistence is snapshot + journal (the per-document-write cost model
+    of the reference's ArangoDB, not rewrite-the-world): single-document
+    mutations append one JSON-lines record to ``{name}.journal`` — O(doc),
+    independent of corpus size — and the full ``{name}.json`` snapshot is
+    rewritten only on bulk loads, clears, or when the journal exceeds
+    ``compact_every`` records (then the journal is truncated).  Startup
+    loads the snapshot and replays the journal; a torn trailing record
+    (crash mid-append) is skipped."""
+
+    def __init__(self, name: str, snapshot_dir: Optional[str] = None,
+                 compact_every: int = 1024):
         self.name = name
         self._docs: dict[str, dict] = {}
         self._lock = threading.Lock()
         self.snapshot_dir = snapshot_dir
+        self.compact_every = compact_every
+        self._journal_fh = None
+        self._journal_records = 0
         if snapshot_dir:
             path = os.path.join(snapshot_dir, f"{name}.json")
             if os.path.exists(path):
                 with open(path) as fh:
                     for doc in json.load(fh):
                         self._docs[doc["id"]] = doc
+            jpath = self._journal_path()
+            if os.path.exists(jpath):
+                with open(jpath) as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail record
+                        if rec.get("op") == "upsert":
+                            self._docs[rec["doc"]["id"]] = rec["doc"]
+                        elif rec.get("op") == "delete":
+                            self._docs.pop(rec["id"], None)
+                        self._journal_records += 1
+
+    def _journal_path(self) -> str:
+        return os.path.join(self.snapshot_dir, f"{self.name}.journal")
+
+    def _append(self, rec: dict) -> None:
+        """One O(doc) journal record; caller holds self._lock.  Rolls the
+        journal into a fresh snapshot past the compaction threshold."""
+        if not self.snapshot_dir:
+            return
+        if self._journal_records >= self.compact_every:
+            self._snapshot()
+            return
+        if self._journal_fh is None:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            self._journal_fh = open(self._journal_path(), "a",
+                                    encoding="utf-8")
+        self._journal_fh.write(json.dumps(rec) + "\n")
+        self._journal_fh.flush()
+        self._journal_records += 1
 
     def _snapshot(self):
+        """Full rewrite + journal truncation; caller holds self._lock."""
         if not self.snapshot_dir:
             return
         os.makedirs(self.snapshot_dir, exist_ok=True)
@@ -51,15 +100,25 @@ class Collection:
         with open(tmp, "w") as fh:
             json.dump(list(self._docs.values()), fh, indent=1)
         os.replace(tmp, path)
+        if self._journal_fh is not None:
+            self._journal_fh.close()
+            self._journal_fh = None
+        try:
+            os.remove(self._journal_path())
+        except OSError:
+            pass
+        self._journal_records = 0
 
     def upsert(self, doc: dict) -> None:
         with self._lock:
-            self._docs[doc["id"]] = copy.deepcopy(doc)
-            self._snapshot()
+            doc = copy.deepcopy(doc)
+            self._docs[doc["id"]] = doc
+            self._append({"op": "upsert", "doc": doc})
 
     def upsert_many(self, docs: list[dict]) -> None:
         """Bulk path: one lock acquisition and one snapshot for the whole
-        list (per-doc upsert would rewrite the full snapshot n times)."""
+        list (per-doc journaling would write n records for a load that a
+        single compacted snapshot represents)."""
         with self._lock:
             for doc in docs:
                 self._docs[doc["id"]] = copy.deepcopy(doc)
@@ -69,8 +128,9 @@ class Collection:
         with self._lock:
             if doc["id"] in self._docs:
                 return False
-            self._docs[doc["id"]] = copy.deepcopy(doc)
-            self._snapshot()
+            doc = copy.deepcopy(doc)
+            self._docs[doc["id"]] = doc
+            self._append({"op": "upsert", "doc": doc})
             return True
 
     def get(self, doc_id: str) -> Optional[dict]:
@@ -81,7 +141,8 @@ class Collection:
     def delete(self, doc_id: str) -> bool:
         with self._lock:
             existed = self._docs.pop(doc_id, None) is not None
-            self._snapshot()
+            if existed:
+                self._append({"op": "delete", "id": doc_id})
             return existed
 
     def all(self) -> list[dict]:
