@@ -49,6 +49,12 @@ _ARRAY_ORDER = [
     "r_subject_id",
 ]
 
+# caps order for acs_enc_batch -- must match Caps in host_encoder.cpp
+_CAPS_ORDER = [
+    "NR", "NI", "NP", "NSUB", "NACT", "NOP", "NOWN", "NRA", "NHR",
+    "NROLE", "NACLE", "NACLI", "NHRR",
+]
+
 _URN_ORDER = [
     "entity", "property", "operation", "resourceID", "role",
     "roleScopingEntity", "roleScopingInstance", "ownerEntity",
@@ -115,6 +121,7 @@ def _load():
             ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int32),  # caps (13 ints) or None
         ]
         _lib = lib
         return _lib
@@ -201,22 +208,40 @@ class NativeBatchEncoder:
         self.lib.acs_enc_string(self._handle, idx, buf, n)
         return buf.raw[:n].decode()
 
-    def encode_wire(self, messages: list[bytes]) -> RequestBatch:
-        """Encode serialized acstpu.Request messages."""
+    def encode_wire(self, messages: list[bytes],
+                    caps: dict[str, int] | None = None) -> RequestBatch:
+        """Encode serialized acstpu.Request messages.
+
+        ``caps`` overrides the per-request padding shapes (the floor
+        defaults otherwise).  Rows that were ineligible ONLY because a
+        cap overflowed come back flagged in ``batch.overcap`` — the
+        serving path re-encodes exactly those rows at the ceiling shapes
+        (ops/encode._CAPS_CEIL) so deep-HR wire traffic stays native."""
         B = len(messages)
         blob = b"".join(messages)
         offs = np.zeros(B + 1, np.int64)
         np.cumsum([len(m) for m in messages], out=offs[1:])
 
-        a = _pyenc.alloc_row_arrays(B)
+        a = _pyenc.alloc_row_arrays(B, caps)
         eligible = np.ones((B,), np.uint8)
-        batch_entities = np.zeros((max(B, 1) * _pyenc.NR,), np.int32)
+        overcap = np.zeros((B,), np.uint8)
+        nr = (caps or _pyenc._CAPS_FLOOR)["NR"]
+        batch_entities = np.zeros((max(B, 1) * nr,), np.int32)
+        caps_arg = None
+        if caps is not None:
+            caps_arr = np.array(
+                [caps[k] for k in _CAPS_ORDER], np.int32
+            )
+            caps_arg = caps_arr.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)
+            )
 
-        ptrs = (ctypes.c_void_p * (len(_ARRAY_ORDER) + 2))()
+        ptrs = (ctypes.c_void_p * (len(_ARRAY_ORDER) + 3))()
         for i, name in enumerate(_ARRAY_ORDER):
             ptrs[i] = a[name].ctypes.data
         ptrs[len(_ARRAY_ORDER)] = eligible.ctypes.data
         ptrs[len(_ARRAY_ORDER) + 1] = batch_entities.ctypes.data
+        ptrs[len(_ARRAY_ORDER) + 2] = overcap.ctypes.data
 
         with self._call_lock:
             n_entities = self.lib.acs_enc_batch(
@@ -225,6 +250,7 @@ class NativeBatchEncoder:
                 offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 B,
                 ptrs,
+                caps_arg,
             )
             if n_entities < 0:
                 raise ValueError("malformed wire batch")
@@ -255,4 +281,5 @@ class NativeBatchEncoder:
             cond_code=np.full((C, B), 200, np.int32),
             eligible=eligible.astype(bool),
             requests=[],
+            overcap=overcap.astype(bool),
         )

@@ -30,10 +30,16 @@
 namespace {
 
 constexpr int32_t ABSENT = -1;
-// padding caps -- must match ops/encode.py
-constexpr int NR = 4, NI = 4, NP = 8, NSUB = 8, NACT = 4, NOP = 2;
-constexpr int NOWN = 4, NRA = 8, NHR = 32, NROLE = 4;
-constexpr int NACLE = 4, NACLI = 8, NHRR = 8;  // must match ops/encode.py
+// padding caps: runtime parameters of acs_enc_batch (13 int32s in the
+// order of ops/encode._CAPS_FLOOR); null means the floor defaults below.
+// The serving path encodes at the floor first and re-encodes over-cap
+// rows (flagged via the overcap output) at the ceiling shapes, so deep-HR
+// traffic stays on the native fast path instead of falling to the oracle.
+struct Caps {
+  int NR = 4, NI = 4, NP = 8, NSUB = 8, NACT = 4, NOP = 2;
+  int NOWN = 4, NRA = 8, NHR = 32, NROLE = 4;
+  int NACLE = 4, NACLI = 8, NHRR = 8;
+};
 
 // ------------------------------------------------------------- interner
 
@@ -476,6 +482,7 @@ struct OutArrays {
   int32_t* r_subject_id;     // [B]
   uint8_t* eligible;         // [B]
   int32_t* batch_entities;   // [B * NR] distinct entity interner ids out
+  uint8_t* overcap;          // [B] ineligible ONLY because a cap overflowed
 };
 
 // entity tail: URN segment after the last ':' -- the reference's
@@ -505,7 +512,7 @@ int32_t intern_jstr(Encoder& enc, const JValue* v) {
 // owners -> (entity, instance) pairs; false on NOWN overflow
 // (mirrors encode.py:_encode_owners)
 bool encode_owners(Encoder& enc, const JValue* owners, int32_t* ent_out,
-                   int32_t* inst_out) {
+                   int32_t* inst_out, int NOWN) {
   if (owners == nullptr || owners->kind != JValue::Arr) return true;
   int slot = 0;
   for (const JValue& owner : owners->arr) {
@@ -639,8 +646,19 @@ int32_t acs_enc_string(void* h, int32_t idx, char* out, int32_t cap) {
 // Returns the number of distinct batch entity values (written to
 // batch_entities as interner ids), or -1 on a malformed wire input.
 int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
-                      int32_t B, void** ptrs) {
+                      int32_t B, void** ptrs, const int32_t* caps) {
   Encoder& enc = *(Encoder*)h;
+  Caps C;
+  if (caps != nullptr) {
+    C.NR = caps[0]; C.NI = caps[1]; C.NP = caps[2]; C.NSUB = caps[3];
+    C.NACT = caps[4]; C.NOP = caps[5]; C.NOWN = caps[6]; C.NRA = caps[7];
+    C.NHR = caps[8]; C.NROLE = caps[9]; C.NACLE = caps[10];
+    C.NACLI = caps[11]; C.NHRR = caps[12];
+  }
+  const int NR = C.NR, NI = C.NI, NP = C.NP, NSUB = C.NSUB, NACT = C.NACT,
+            NOP = C.NOP, NOWN = C.NOWN, NRA = C.NRA, NHR = C.NHR,
+            NROLE = C.NROLE, NACLE = C.NACLE, NACLI = C.NACLI,
+            NHRR = C.NHRR;
   OutArrays o;
   int pi = 0;
   o.r_sub_ids = (int32_t*)ptrs[pi++];
@@ -682,6 +700,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
   o.r_subject_id = (int32_t*)ptrs[pi++];
   o.eligible = (uint8_t*)ptrs[pi++];
   o.batch_entities = (int32_t*)ptrs[pi++];
+  o.overcap = (uint8_t*)ptrs[pi++];
 
   std::unordered_map<int32_t, int32_t> batch_entity_idx;
   int32_t n_batch_entities = 0;
@@ -720,6 +739,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
     // ---- subject / roles / actions
     if ((int)req.subjects.size() > NSUB || (int)req.actions.size() > NACT) {
       o.eligible[b] = 0;
+      o.overcap[b] = 1;
       continue;
     }
     for (size_t j = 0; j < req.subjects.size(); ++j) {
@@ -750,6 +770,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
     }
     if ((int)roles.size() > NROLE) {
       o.eligible[b] = 0;
+      o.overcap[b] = 1;
       continue;
     }
     for (size_t j = 0; j < roles.size(); ++j)
@@ -782,13 +803,14 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
     }
     size_t total_instances = 0;
     for (const Run& run : runs) total_instances += run.instances.size();
-    if (!ok || (int)runs.size() > NR || (int)props.size() > NP ||
-        (int)ops.size() > NOP) {
+    if (!ok) {
       o.eligible[b] = 0;
       continue;
     }
-    if ((int)total_instances > NI) {
+    if ((int)runs.size() > NR || (int)props.size() > NP ||
+        (int)ops.size() > NOP || (int)total_instances > NI) {
       o.eligible[b] = 0;
+      o.overcap[b] = 1;
       continue;
     }
     if (enc.tails_ambiguous && !props.empty()) {
@@ -916,6 +938,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
         for (int32_t i : insts) absent |= i < 0;
       if (over || absent) {
         o.eligible[b] = 0;  // ACL shape beyond caps/ABSENT values: fallback
+        if (over && !absent) o.overcap[b] = 1;
         continue;
       }
       for (size_t e = 0; e < acl_ents.size(); ++e) {
@@ -959,7 +982,8 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
           o.r_inst_has_owners[b * NI + inst_slot] = have ? 1 : 0;
           if (!encode_owners(enc, owners,
                              o.r_inst_owner_ent + (b * NI + inst_slot) * NOWN,
-                             o.r_inst_owner_inst + (b * NI + inst_slot) * NOWN))
+                             o.r_inst_owner_inst + (b * NI + inst_slot) * NOWN,
+                             NOWN))
             overflow = true;
         }
         ++inst_slot;
@@ -990,7 +1014,8 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
         o.r_op_has_owners[b * NOP + j] = have ? 1 : 0;
         if (!encode_owners(enc, owners,
                            o.r_op_owner_ent + (b * NOP + j) * NOWN,
-                           o.r_op_owner_inst + (b * NOP + j) * NOWN))
+                           o.r_op_owner_inst + (b * NOP + j) * NOWN,
+                           NOWN))
           overflow = true;
       }
     }
@@ -1063,6 +1088,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
         (int)hr_enc.size() > NHR || (int)acl_hr_enc.size() > NHR ||
         (int)hr_roles.size() > NHRR || overflow) {
       o.eligible[b] = 0;
+      o.overcap[b] = 1;
       continue;
     }
     for (size_t j = 0; j < ra3.size(); ++j)

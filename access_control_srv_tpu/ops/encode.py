@@ -42,8 +42,9 @@ from .interner import ABSENT
 # the batch maximum (floor = these defaults, hard ceiling = _CAPS_CEIL),
 # so deep-HR / wide-ACL traffic stays kernel-eligible instead of falling
 # to the scalar oracle, while common traffic keeps one compiled shape.
-# The native (C++) wire encoder keeps the floor shapes; its over-cap rows
-# fall back to the Python path's adaptive encoding via eligibility.
+# The native (C++) wire encoder takes the same caps at runtime: the wire
+# path encodes at the floor and re-encodes over-cap rows (batch.overcap)
+# at _CAPS_CEIL, so deep rows stay on the native fast path too.
 NR = 4      # entity runs
 NI = 4      # resource instances
 NP = 8      # property attributes
@@ -219,6 +220,9 @@ class RequestBatch:
     # (condition index, row) -> error text for abort rows (the reference's
     # operation_status.message, recovered without an oracle re-run)
     cond_msg: dict = field(default_factory=dict)
+    # rows ineligible ONLY because a padding cap overflowed (native wire
+    # encoder); the serving path re-encodes them at the ceiling shapes
+    overcap: Optional[np.ndarray] = None
 
 
 class _RegexCache:

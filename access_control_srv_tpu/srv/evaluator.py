@@ -20,6 +20,8 @@ import copy
 import threading
 from typing import Optional
 
+import numpy as np
+
 from ..core.engine import AccessController
 from ..models.model import Decision, OperationStatus, Response
 from ..ops.compile import DECISION_NAMES, compile_policies
@@ -150,6 +152,34 @@ class HybridEvaluator:
             return None
         batch = encoder.encode_wire(messages)
         decision, cacheable, status = kernel.evaluate(batch)
+        if batch.overcap is not None and batch.overcap.any():
+            # adaptive caps, native path: rows that overflowed the floor
+            # shapes re-encode natively at the ceiling (one extra native
+            # call + one extra kernel dispatch for the rare deep rows)
+            # instead of falling back to the scalar oracle
+            from ..ops.encode import _CAPS_CEIL
+
+            idx = [
+                b for b in range(len(messages))
+                if batch.overcap[b] and not batch.eligible[b]
+            ]
+            retry = encoder.encode_wire(
+                [messages[b] for b in idx], caps=dict(_CAPS_CEIL)
+            )
+            d2, c2, s2 = kernel.evaluate(retry)
+            # kernel outputs are read-only views on device buffers
+            decision = np.array(decision)
+            cacheable = np.array(cacheable)
+            status = np.array(status)
+            n_retried = 0
+            for j, b in enumerate(idx):
+                if retry.eligible[j]:
+                    batch.eligible[b] = True
+                    decision[b] = d2[j]
+                    cacheable[b] = c2[j]
+                    status[b] = s2[j]
+                    n_retried += 1
+            self._count_path("native-wire-ceil", n_retried)
         n_served = sum(
             1 for b in range(len(messages))
             if batch.eligible[b] and status[b] == 200

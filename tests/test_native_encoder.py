@@ -384,3 +384,87 @@ def test_wire_acl_absent_values_ineligible():
     assert not nb.eligible[1]
     assert nb.eligible[2]
     assert np.array_equal(nb.eligible, pb_batch.eligible)
+
+
+def _deep_hr_request(n_nodes: int, role="member", owner="org-1-x"):
+    """A role_scopes-fixture request whose HR tree flattens to n_nodes
+    pairs (over the NHR floor of 32 when n_nodes > 32)."""
+    from .utils import URNS, build_request
+
+    ORG = "urn:restorecommerce:acs:model:organization.Organization"
+    LOC = "urn:restorecommerce:acs:model:location.Location"
+    # wide tree (depth 2): n_nodes flattened pairs without tripping the
+    # JSON parser's nesting-depth cap
+    node = {
+        "id": "org-0-n",
+        "role": role,
+        "children": [
+            {"id": f"org-{i + 1}-n"} for i in range(n_nodes - 1)
+        ],
+    }
+    return build_request(
+        subject_id="deep-user",
+        subject_role=role,
+        role_scoping_entity=ORG,
+        role_scoping_instance="org-0-n",
+        resource_type=LOC,
+        resource_id="L1",
+        action_type="urn:restorecommerce:acs:names:action:read",
+        owner_indicatory_entity=ORG,
+        owner_instance=owner,
+        hierarchical_scopes=[node],
+    )
+
+
+def test_overcap_flag_and_ceiling_reencode():
+    """Rows beyond the floor caps are flagged overcap (not just
+    ineligible), and a ceiling-caps re-encode makes them eligible with
+    kernel decisions matching the oracle."""
+    engine = make_engine("role_scopes.yml")
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    enc = native.NativeBatchEncoder(compiled)
+
+    deep = _deep_hr_request(64, owner="org-40-n")
+    shallow = _deep_hr_request(3, owner="org-1-n")
+    messages, twins = wire_roundtrip([deep, shallow])
+
+    floor_batch = enc.encode_wire(messages)
+    assert not floor_batch.eligible[0] and floor_batch.overcap[0]
+    assert floor_batch.eligible[1] and not floor_batch.overcap[1]
+
+    from access_control_srv_tpu.ops.encode import _CAPS_CEIL
+
+    ceil_batch = enc.encode_wire(messages, caps=dict(_CAPS_CEIL))
+    assert ceil_batch.eligible.all()
+    kernel = DecisionKernel(compiled)
+    dec, _, status = kernel.evaluate(ceil_batch)
+    for b, req in enumerate(twins):
+        expected = engine.is_allowed(req)
+        assert dec[b] == DEC_CODE[expected.decision], b
+        assert status[b] == 200
+
+
+def test_wire_path_serves_deep_hr_rows_via_ceiling():
+    """The serving path keeps over-cap rows native: the evaluator
+    re-encodes them at the ceiling and the telemetry records the path."""
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.telemetry import Telemetry
+
+    engine = make_engine("role_scopes.yml")
+    telemetry = Telemetry()
+    ev = HybridEvaluator(engine, telemetry=telemetry)
+    if not ev.native_active:
+        pytest.skip("native encoder not active for this tree")
+
+    reqs = [_deep_hr_request(64, owner="org-40-n"),
+            _deep_hr_request(3, owner="org-1-n"),
+            _deep_hr_request(50, owner="nowhere")]
+    messages, twins = wire_roundtrip(reqs)
+    out = ev.is_allowed_batch_wire(messages)
+    assert out is not None
+    batch, decision, cacheable, status = out
+    assert bool(batch.eligible.all()), "deep rows must stay native"
+    assert telemetry.paths.get("native-wire-ceil") == 2
+    for b, req in enumerate(twins):
+        expected = engine.is_allowed(req)
+        assert decision[b] == DEC_CODE[expected.decision], b
