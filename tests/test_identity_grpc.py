@@ -12,6 +12,7 @@ the request arriving over the gRPC transport."""
 import json
 import os
 import threading
+import time
 
 import pytest
 
@@ -146,3 +147,187 @@ def test_token_cache_and_user_modified_eviction(rig):
     )
     client.is_allowed(token_request("net-tok-3"))
     assert ids.calls.count("net-tok-3") == 2
+
+
+class GatedIdentityServer:
+    """Mock IDS whose handler blocks on a gate (and can sleep): drives the
+    client's in-flight / timeout behavior under real gRPC concurrency —
+    the reference's subtlest races live between findByToken resolution and
+    userModified cache eviction (src/worker.ts:252-340)."""
+
+    def __init__(self, subjects_by_token=None, delay: float = 0.0):
+        import json
+        import threading
+        from concurrent import futures
+
+        import grpc
+
+        from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+        self.subjects_by_token = subjects_by_token or {}
+        self.gate = threading.Event()
+        self.gate.set()
+        self.delay = delay
+        self.calls = []
+        self._lock = threading.Lock()
+
+        def find_by_token(request, context):
+            with self._lock:
+                self.calls.append(request.token)
+            self.gate.wait(timeout=30)
+            if self.delay:
+                time.sleep(self.delay)
+            payload = self.subjects_by_token.get(request.token)
+            if payload is None:
+                return pb.SubjectResponse(
+                    payload=b"",
+                    status=pb.OperationStatus(code=404, message="not found"),
+                )
+            return pb.SubjectResponse(
+                payload=json.dumps(payload).encode(),
+                status=pb.OperationStatus(code=200, message="success"),
+            )
+
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+        handler = grpc.method_handlers_generic_handler(
+            "acstpu.IdentityService",
+            {
+                "FindByToken": grpc.unary_unary_rpc_method_handler(
+                    find_by_token,
+                    request_deserializer=pb.FindByTokenRequest.FromString,
+                    response_serializer=pb.SubjectResponse.SerializeToString,
+                ),
+            },
+        )
+        self.server.add_generic_rpc_handlers((handler,))
+        self.port = self.server.add_insecure_port("127.0.0.1:0")
+        self.server.start()
+
+    @property
+    def address(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.gate.set()
+        self.server.stop(grace=None)
+
+
+def test_timeout_flood_fails_closed_and_recovers():
+    """A flood of resolutions against a too-slow IDS all fail closed
+    (503, payload None); after the server speeds up the client recovers
+    without restart."""
+    import threading
+
+    from access_control_srv_tpu.srv.identity import GrpcIdentityClient
+
+    ids = GatedIdentityServer({"tok": {"id": "u"}}, delay=0.5)
+    client = GrpcIdentityClient(ids.address, timeout=0.1)
+    try:
+        results = [None] * 24
+
+        def resolve(i):
+            results[i] = client.find_by_token("tok")
+
+        threads = [threading.Thread(target=resolve, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(r is not None for r in results)
+        assert all(r["payload"] is None for r in results)
+        assert all(r["status"]["code"] == 503 for r in results)
+
+        ids.delay = 0.0  # server recovers; same client object
+        ok = client.find_by_token("tok")
+        assert ok["payload"] == {"id": "u"}
+    finally:
+        client.close()
+        ids.stop()
+
+
+def test_eviction_during_in_flight_resolution_not_reinserted():
+    """userModified-style eviction racing an in-flight resolution: the
+    stale payload must not repopulate the cache after the eviction — the
+    next lookup re-resolves and sees the NEW payload."""
+    import threading
+
+    from access_control_srv_tpu.srv.identity import GrpcIdentityClient
+
+    ids = GatedIdentityServer({"tok": {"id": "u", "v": "old"}})
+    client = GrpcIdentityClient(ids.address, timeout=10)
+    try:
+        ids.gate.clear()  # block the handler mid-resolution
+        in_flight = []
+        threads = [
+            threading.Thread(
+                target=lambda: in_flight.append(client.find_by_token("tok"))
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10
+        while not ids.calls and time.time() < deadline:
+            time.sleep(0.01)
+        assert ids.calls, "handler never reached"
+
+        # the user is mutated while resolutions are parked in the server
+        ids.subjects_by_token["tok"] = {"id": "u", "v": "new"}
+        client.evict("tok")
+
+        ids.gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(in_flight) == 8
+        # in-flight callers may see the old payload (they began before the
+        # mutation) but the CACHE must not: the next lookup re-resolves
+        n_calls = len(ids.calls)
+        fresh = client.find_by_token("tok")
+        assert fresh["payload"] == {"id": "u", "v": "new"}
+        assert len(ids.calls) == n_calls + 1  # not served from a stale cache
+    finally:
+        client.close()
+        ids.stop()
+
+
+def test_identity_soak_concurrent_resolutions_and_evictions():
+    """Soak: 16 threads x 40 lookups over 8 tokens with interleaved
+    evictions; no exceptions, every result is either fail-closed or the
+    correct payload for its token, and the cache stays bounded."""
+    import random
+    import threading
+
+    from access_control_srv_tpu.srv.identity import GrpcIdentityClient
+
+    tokens = {f"tok-{i}": {"id": f"user-{i}"} for i in range(8)}
+    ids = GatedIdentityServer(dict(tokens))
+    client = GrpcIdentityClient(ids.address, timeout=5, cache_size=4)
+    errors = []
+
+    def hammer(seed):
+        rng = random.Random(seed)
+        try:
+            for _ in range(40):
+                tok = f"tok-{rng.randrange(8)}"
+                out = client.find_by_token(tok)
+                if out["payload"] is not None:
+                    if out["payload"] != tokens[tok]:
+                        errors.append((tok, out))
+                if rng.random() < 0.2:
+                    client.evict(tok if rng.random() < 0.5 else None)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    try:
+        threads = [threading.Thread(target=hammer, args=(s,))
+                   for s in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        assert len(client._cache) <= 4
+    finally:
+        client.close()
+        ids.stop()
