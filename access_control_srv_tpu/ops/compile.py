@@ -333,6 +333,19 @@ def compile_policies(
 
     arrays = dict(a)
     arrays.update(table.to_arrays())
+    # (role, scoping) vocabulary for stage B: the owner-membership sweeps
+    # are factored per distinct (t_role, t_scoping) pair — typically far
+    # fewer than T — and gathered back per target row (kernel
+    # _match_targets owner_checks).  The vocab arrays are global
+    # (group-invariant under prefilter compaction); t_rs_idx is a regular
+    # target-table column so row subsets keep it aligned.
+    rs_pairs = np.stack(
+        [arrays["t_role"], arrays["t_scoping"]], axis=1
+    )
+    rs_vocab, t_rs = np.unique(rs_pairs, axis=0, return_inverse=True)
+    arrays["t_rs_idx"] = t_rs.reshape(-1).astype(np.int32)
+    arrays["hrv_role"] = np.ascontiguousarray(rs_vocab[:, 0], np.int32)
+    arrays["hrv_scope"] = np.ascontiguousarray(rs_vocab[:, 1], np.int32)
     # interned URN ids the ACL kernel stage compares against (reference:
     # verifyACL.ts:37-44, 138-150): [role attr id, user entity, actionID
     # attr id, create, read, modify, delete]

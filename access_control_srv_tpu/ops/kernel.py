@@ -515,40 +515,60 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
         ~r["r_inst_present"] | ~r["r_inst_has_owners"]
     )[None, :]
 
-    # owner pair checks against role associations / HR closure
+    # owner pair checks against role associations / HR closure, factored
+    # per distinct (role, scoping) vocab pair (compile.py hrv_*): the
+    # membership sweeps over ra3/hr run at [RV, ...] instead of
+    # [T, ...], the NHR sweep becomes ONE boolean matmul
+    # (role-hit [RV, NHR] x inst-hit [NHR, Q] on the MXU), and the
+    # results gather back per target row via t_rs_idx.  Semantics are
+    # unchanged from the direct broadcast (reference:
+    # hierarchicalScope.ts:165-245).
+    rv_role = c["hrv_role"]    # [RV]
+    rv_scope = c["hrv_scope"]  # [RV]
+    t_rs = c["t_rs_idx"]       # [T]
+    ra3 = r["r_ra3"]  # [NRA, 3]
+    ra3_valid = ra3[:, 1] >= 0
+    rs_hit3 = (
+        (rv_role[:, None] == ra3[None, :, 0])
+        & (rv_scope[:, None] == ra3[None, :, 1])
+        & ra3_valid[None, :]
+    )  # [RV, NRA]
+    ra2 = r["r_ra2"]
+    ra2_valid = ra2[:, 1] >= 0
+    ra2_ok_v = (
+        (rv_role[:, None] == ra2[None, :, 0])
+        & (rv_scope[:, None] == ra2[None, :, 1])
+        & ra2_valid[None, :]
+    ).any(axis=1)  # [RV]
+    hr = r["r_hr"]
+    hr_valid = hr[:, 1] >= 0
+    role_hit = (rv_role[:, None] == hr[None, :, 0]) & hr_valid[None, :]
+
     def owner_checks(owner_ent, owner_inst):
         # owner_ent/owner_inst: [N, NOWN]; returns direct/hier [T, N]
-        o_valid = owner_ent >= 0
-        ent_match = (
-            c["t_scoping"][:, None, None] == owner_ent[None, :, :]
-        ) & o_valid[None, :, :]  # [T, N, NOWN]
+        N, NOWN = owner_inst.shape
+        q_ent = owner_ent.reshape(-1)    # [Q = N*NOWN]
+        q_inst = owner_inst.reshape(-1)
+        ent_match_v = (
+            rv_scope[:, None] == q_ent[None, :]
+        ) & (q_ent >= 0)[None, :]  # [RV, Q]
         # direct: (role, scoping, owner-instance) in ra3
-        ra3 = r["r_ra3"]  # [NRA, 3]
-        ra3_valid = ra3[:, 1] >= 0
-        direct_pair = (
-            (c["t_role"][:, None, None, None] == ra3[None, None, None, :, 0])
-            & (c["t_scoping"][:, None, None, None] == ra3[None, None, None, :, 1])
-            & (owner_inst[None, :, :, None] == ra3[None, None, None, :, 2])
-            & ra3_valid[None, None, None, :]
-        ).any(axis=3)  # [T, N, NOWN]
-        direct = (ent_match & direct_pair).any(axis=2)  # [T, N]
+        inst_hit3 = q_inst[:, None] == ra3[None, :, 2]  # [Q, NRA]
+        direct_cnt = jnp.matmul(
+            rs_hit3.astype(jnp.float32),
+            inst_hit3.astype(jnp.float32).T,
+        )  # [RV, Q]
+        direct_v = ent_match_v & (direct_cnt > 0)
         # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
-        ra2 = r["r_ra2"]
-        ra2_valid = ra2[:, 1] >= 0
-        ra2_ok = (
-            (c["t_role"][:, None] == ra2[None, :, 0])
-            & (c["t_scoping"][:, None] == ra2[None, :, 1])
-            & ra2_valid[None, :]
-        ).any(axis=1)  # [T]
-        hr = r["r_hr"]
-        hr_valid = hr[:, 1] >= 0
-        hr_pair = (
-            (c["t_role"][:, None, None, None] == hr[None, None, None, :, 0])
-            & (owner_inst[None, :, :, None] == hr[None, None, None, :, 1])
-            & hr_valid[None, None, None, :]
-        ).any(axis=3)  # [T, N, NOWN]
-        hier = (ent_match & hr_pair).any(axis=2) & ra2_ok[:, None]
-        return direct, hier
+        inst_hit = q_inst[:, None] == hr[None, :, 1]  # [Q, NHR]
+        hier_cnt = jnp.matmul(
+            role_hit.astype(jnp.float32),
+            inst_hit.astype(jnp.float32).T,
+        )  # [RV, Q]
+        hier_v = ent_match_v & (hier_cnt > 0) & ra2_ok_v[:, None]
+        direct = direct_v.reshape(-1, N, NOWN).any(axis=2)  # [RV, N]
+        hier = hier_v.reshape(-1, N, NOWN).any(axis=2)
+        return jnp.take(direct, t_rs, axis=0), jnp.take(hier, t_rs, axis=0)
 
     inst_direct, inst_hier = owner_checks(
         r["r_inst_owner_ent"], r["r_inst_owner_inst"]

@@ -48,7 +48,7 @@ _T_FIELDS = [
     "t_n_subjects", "t_role", "t_has_role", "t_scoping", "t_has_scoping",
     "t_hr_check", "t_skip_acl", "t_sub_ids", "t_sub_vals", "t_act_ids",
     "t_act_vals", "t_ent_vals", "t_ent_w", "t_ent_tails", "t_op_vals",
-    "t_prop_vals", "t_prop_sfx", "t_has_props", "t_n_res",
+    "t_prop_vals", "t_prop_sfx", "t_has_props", "t_n_res", "t_rs_idx",
 ]
 
 
@@ -119,7 +119,7 @@ def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
         "set_valid", "set_ca", "set_has_target", "pol_valid", "pol_ca",
         "pol_effect", "pol_cacheable", "pol_has_target", "pol_has_subjects",
         "pol_n_rules", "pol_eff_ctx", "pol_has_props", "pol_ent_vals",
-        "acl_consts",
+        "acl_consts", "hrv_role", "hrv_scope",
     ]
     stacked: dict[str, np.ndarray] = {}
     for name in list(shard_arrays[0]):
