@@ -726,6 +726,91 @@ def _policy_gates(c: dict, r: dict, m: dict):
     return pol_gate, set_gate, pol_subject
 
 
+def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
+                             cond_a, cond_c, pol_gate, set_gate):
+    """Flat-rule-axis variant of _combine_and_decide for the signature
+    kernel: inputs arrive as [S, KP*KR] planes and the per-policy KR
+    reductions run as reduce_windows, so batched callers avoid
+    [B, S, KP, KR] intermediates whose tiny trailing dim pads to the
+    TPU's 128-lane tile (8x memory at KR=16).  Flat positions preserve
+    the original (set, policy, rule) ordering, so first/last semantics
+    and the abort's flat-order selection are unchanged."""
+    S, KP, KR = c["rule_effect"].shape
+    M = KP * KR
+    re_f = c["rule_effect"].reshape(S, M)
+    cach_eff_f = c["rule_cacheable_eff"].reshape(S, M)
+    cach_raw_f = c["rule_cacheable_raw"].reshape(S, M)
+
+    scope = set_gate[:, None] & pol_gate          # [S, KP]
+    scope_f = jnp.repeat(scope, KR, axis=1)       # [S, M]
+    abort_rule = reached & has_cond & cond_a & scope_f
+    matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
+    coll = matches & scope_f
+
+    m_pos = jnp.broadcast_to(
+        jnp.arange(M, dtype=jnp.int32)[None, :], (S, M)
+    )
+
+    def win_min(x):
+        return jax.lax.reduce_window(
+            x, jnp.int32(BIG), jax.lax.min, (1, KR), (1, KR), "VALID"
+        )
+
+    def win_max(x):
+        return jax.lax.reduce_window(
+            x, jnp.int32(-1), jax.lax.max, (1, KR), (1, KR), "VALID"
+        )
+
+    first_deny = win_min(jnp.where(coll & (re_f == 2), m_pos, BIG))
+    first_permit = win_min(jnp.where(coll & (re_f == 1), m_pos, BIG))
+    first_coll = win_min(jnp.where(coll, m_pos, BIG))
+    last_coll = win_max(jnp.where(coll, m_pos, -1))
+    any_coll = win_max(coll.astype(jnp.int32)) > 0
+
+    sel_do = jnp.where(first_deny < BIG, first_deny, last_coll)
+    sel_po = jnp.where(first_permit < BIG, first_permit, last_coll)
+    sel = jnp.select(
+        [c["pol_ca"] == 0, c["pol_ca"] == 1, c["pol_ca"] == 2],
+        [sel_do, sel_po, first_coll],
+        default=jnp.zeros_like(sel_do),
+    )
+    sel_c = jnp.clip(sel, 0, M - 1)
+    rule_eff_sel = jnp.take_along_axis(re_f, sel_c, axis=1)
+    rule_cach_sel = jnp.take_along_axis(cach_eff_f, sel_c, axis=1)
+
+    no_rules_contrib = (
+        c["pol_valid"]
+        & set_gate[:, None]
+        & pol_gate
+        & (c["pol_n_rules"] == 0)
+        & (c["pol_effect"] > 0)
+    )
+    contrib_present = no_rules_contrib | any_coll
+    contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
+    contrib_cach = jnp.where(
+        no_rules_contrib, c["pol_cacheable"], rule_cach_sel
+    )
+    decision, cacheable = _combine_sets(
+        c, contrib_present, contrib_eff, contrib_cach
+    )
+    status = jnp.int32(200)
+
+    # condition aborts preempt everything, first in flat rule order
+    # (s*M + m == s*(KP*KR) + kp*KR + kr: identical to the 3-D variant)
+    flat_order = jnp.arange(S * M, dtype=jnp.int32).reshape(S, M)
+    abort_pos = jnp.min(jnp.where(abort_rule, flat_order, BIG))
+    has_abort = abort_pos < BIG
+    abort_flat = jnp.clip(abort_pos, 0, S * M - 1)
+    abort_code = jnp.take(cond_c.reshape(-1), abort_flat)
+    abort_cach = jnp.take(cach_raw_f.reshape(-1), abort_flat).astype(
+        jnp.int32
+    )
+    decision = jnp.where(has_abort, 2, decision)
+    cacheable = jnp.where(has_abort, abort_cach, cacheable)
+    status = jnp.where(has_abort, abort_code, status)
+    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+
+
 def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     """Stages F-G (pre-abort): policy-effect combination per set and the
     last-set-wins decision; shared by both kernels."""

@@ -47,7 +47,7 @@ from .encode import RequestBatch
 from .kernel import (
     DecisionKernel,
     _action_kind,
-    _combine_and_decide,
+    _combine_and_decide_flat,
     _evaluate_one,
     _match_targets,
     _multi_entity_ok,
@@ -290,7 +290,7 @@ class PrefilteredKernel:
             self._runs[key] = run
         return run
 
-    def _sig_runner(self, schedule: tuple):
+    def _sig_runner(self, schedule: tuple, needs_pairs: bool = True):
         """The signature-plane kernel: stage A (resource/action target
         matching) is pre-gathered to rule/policy/set granularity per
         signature (_planes_for), so the per-row device work is pure
@@ -303,7 +303,7 @@ class PrefilteredKernel:
         host->device transfer (the TPU tunnel pays per-transfer latency —
         ~35 small puts per call were costing ~10x the compute), and the
         three outputs return stacked as one [3, B] readback."""
-        key = ("sig", schedule)
+        key = ("sig", schedule, needs_pairs)
         run = self._runs.get(key)
         if run is None:
             c_inv = self._c_inv
@@ -311,10 +311,16 @@ class PrefilteredKernel:
             def sub_fold(r, n_sub, has_role, role, sub_ids, sub_vals):
                 # checkSubjectMatches at plane granularity (reference:
                 # accessController.ts:793-823); broadcasts over the
-                # plane's leading shape
+                # plane's leading shape.  ``needs_pairs`` is a static
+                # property of the signature set: when every subject-
+                # bearing row is role-targeted, the (id, value) pair
+                # subset check — the widest intermediate of the runner —
+                # is skipped entirely.
                 role_ok = (
                     (role[..., None] == r["r_roles"]) & (r["r_roles"] >= 0)
                 ).any(-1)
+                if not needs_pairs:
+                    return (n_sub == 0) | role_ok
                 eq = (
                     (sub_ids[..., :, None] == r["r_sub_ids"])
                     & (sub_vals[..., :, None] == r["r_sub_vals"])
@@ -342,9 +348,20 @@ class PrefilteredKernel:
                         "cond_code": ra["cond_code"],
                     }
 
-                    rl_sub = sub_fold(rr, sg["rl_n_sub"], sg["rl_has_role"],
-                                      sg["rl_role"], sg["rl_sub_ids"],
-                                      sg["rl_sub_vals"])  # [S, KP, KR]
+                    # rule-level work runs on [S, KP*KR] planes: the flat
+                    # last axis keeps TPU lanes full (KR=16 trailing dims
+                    # pad to 128) and bounds batch memory
+                    S_, KP_, KR_ = c["rule_effect"].shape
+
+                    def flat(x):
+                        return x.reshape(S_, KP_ * KR_)
+
+                    rl_sub = sub_fold(
+                        rr, flat(sg["rl_n_sub"]), flat(sg["rl_has_role"]),
+                        flat(sg["rl_role"]),
+                        sg["rl_sub_ids"].reshape(S_, KP_ * KR_, -1),
+                        sg["rl_sub_vals"].reshape(S_, KP_ * KR_, -1),
+                    )  # [S, M]
                     pl_sub = sub_fold(rr, sg["pl_n_sub"], sg["pl_has_role"],
                                       sg["pl_role"], sg["pl_sub_ids"],
                                       sg["pl_sub_vals"])  # [S, KP]
@@ -352,17 +369,22 @@ class PrefilteredKernel:
                                       sg["sl_role"], sg["sl_sub_ids"],
                                       sg["sl_sub_vals"])  # [S]
 
-                    tm_rule = ~c["rule_has_target"] | (
-                        rl_sub & (sg["rl_ex"] | sg["rl_rg"])
+                    rht_f = flat(c["rule_has_target"])
+                    tm_rule = ~rht_f | (
+                        rl_sub & (flat(sg["rl_ex"]) | flat(sg["rl_rg"]))
                     )
-                    reached = c["rule_valid"] & tm_rule
+                    reached = flat(c["rule_valid"]) & tm_rule
                     kind = _action_kind(c, rr)
                     short = rr["r_acl_short"]
-                    acl_row = sg["rl_skip"] | (short == 1) | (
+                    acl_row = flat(sg["rl_skip"]) | (short == 1) | (
                         (short == 0) & (rr["r_n_ra"] > 0) & (kind > 0)
                     )
-                    acl_rule = ~c["rule_has_target"] | acl_row
+                    acl_rule = ~rht_f | acl_row
                     has_cond, cond_t, cond_a, cond_c = _rule_conditions(c, rr)
+                    has_cond, cond_t, cond_a, cond_c = (
+                        flat(has_cond), flat(cond_t), flat(cond_a),
+                        flat(cond_c),
+                    )
 
                     # policy gates via the shared core (reference:
                     # accessController.ts:130-195): subject fold
@@ -379,11 +401,10 @@ class PrefilteredKernel:
                     set_gate = (
                         ~c["set_has_target"] | (sg["ss_ex_p"] & sl_sub)
                     ) & c["set_valid"]
-                    pol_subject = jnp.ones_like(pol_gate)
 
-                    return _combine_and_decide(
+                    return _combine_and_decide_flat(
                         c, reached, acl_rule, has_cond, cond_t, cond_a,
-                        cond_c, pol_gate, set_gate, pol_subject,
+                        cond_c, pol_gate, set_gate,
                     )
 
                 return jnp.stack(jax.vmap(one)(mega))
@@ -761,7 +782,13 @@ class PrefilteredKernel:
                 )
                 schedule.append((nm, C, (C,)))
             mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
-            run = self._sig_runner(tuple(schedule))
+            # static: does ANY subject-bearing target row in this stack
+            # match by attribute pairs instead of role?
+            needs_pairs = bool(
+                (~np.asarray(stacked["t_has_role"])
+                 & (np.asarray(stacked["t_n_subjects"]) > 0)).any()
+            )
+            run = self._sig_runner(tuple(schedule), needs_pairs)
             cs = {k: v for k, v in stacked.items() if k in _SIG_C_KEYS}
             out = np.asarray(run(cs, bits, jnp.asarray(mega)))
             return tuple(out[i][:B] for i in range(3))

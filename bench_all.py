@@ -585,9 +585,10 @@ def bench_stress():
     urns = Urns()
     n_rules = int(os.environ.get("STRESS_RULES", 100_000))
     total = int(os.environ.get("STRESS_TOTAL", 1 << 17))
-    # 8192-row chunks amortize the per-dispatch transfer latency (the
-    # tunnel's round-trip floor is ~100ms regardless of payload size)
-    chunk = int(os.environ.get("STRESS_CHUNK", 8192))
+    # 16384-row chunks amortize the per-dispatch transfer latency (the
+    # tunnel's round-trip floor is ~100ms regardless of payload size);
+    # measured optimum on the v5 lite chip
+    chunk = int(os.environ.get("STRESS_CHUNK", 16384))
 
     t0 = time.perf_counter()
     engine, actual_rules = _stress_engine(n_rules)
