@@ -293,6 +293,95 @@ def _acl_pass(c: dict, r: dict, with_acl: bool):
     return skip | (short == 1) | ((short == 0) & pair_ok)
 
 
+def _make_owner_checks(rv_role, rv_scope, r: dict):
+    """Owner pair checks against role associations / HR closure at
+    (role, scoping)-vocab granularity (reference:
+    hierarchicalScope.ts:165-245).  Returns a closure mapping owner
+    (entity, instance) pair arrays [N, NOWN] to (direct_v, hier_v)
+    [RV, N]; callers gather to their own granularity (target rows in the
+    dense kernel, rule/policy planes in the signature kernel).  The NHR
+    membership conjunction runs as a boolean matmul (f32 accumulate,
+    exact for counts < 2^24) that lands on the MXU."""
+    ra3 = r["r_ra3"]  # [NRA, 3]
+    ra3_valid = ra3[:, 1] >= 0
+    rs_hit3 = (
+        (rv_role[:, None] == ra3[None, :, 0])
+        & (rv_scope[:, None] == ra3[None, :, 1])
+        & ra3_valid[None, :]
+    )  # [RV, NRA]
+    ra2 = r["r_ra2"]
+    ra2_valid = ra2[:, 1] >= 0
+    ra2_ok_v = (
+        (rv_role[:, None] == ra2[None, :, 0])
+        & (rv_scope[:, None] == ra2[None, :, 1])
+        & ra2_valid[None, :]
+    ).any(axis=1)  # [RV]
+    hr = r["r_hr"]
+    hr_valid = hr[:, 1] >= 0
+    role_hit = (rv_role[:, None] == hr[None, :, 0]) & hr_valid[None, :]
+
+    def owner_checks(owner_ent, owner_inst):
+        # owner_ent/owner_inst: [N, NOWN]; returns direct/hier [RV, N]
+        N, NOWN = owner_inst.shape
+        q_ent = owner_ent.reshape(-1)    # [Q = N*NOWN]
+        q_inst = owner_inst.reshape(-1)
+        ent_match_v = (
+            rv_scope[:, None] == q_ent[None, :]
+        ) & (q_ent >= 0)[None, :]  # [RV, Q]
+        # direct: (role, scoping, owner-instance) in ra3
+        inst_hit3 = q_inst[:, None] == ra3[None, :, 2]  # [Q, NRA]
+        direct_cnt = jnp.matmul(
+            rs_hit3.astype(jnp.float32),
+            inst_hit3.astype(jnp.float32).T,
+        )  # [RV, Q]
+        direct_v = ent_match_v & (direct_cnt > 0)
+        # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
+        inst_hit = q_inst[:, None] == hr[None, :, 1]  # [Q, NHR]
+        hier_cnt = jnp.matmul(
+            role_hit.astype(jnp.float32),
+            inst_hit.astype(jnp.float32).T,
+        )  # [RV, Q]
+        hier_v = ent_match_v & (hier_cnt > 0) & ra2_ok_v[:, None]
+        direct = direct_v.reshape(-1, N, NOWN).any(axis=2)  # [RV, N]
+        hier = hier_v.reshape(-1, N, NOWN).any(axis=2)
+        return direct, hier
+
+    return owner_checks
+
+
+def _hr_collect_state(c: dict, r: dict, rgx_hit, pfx_neq, ent_valid):
+    """Stage B's signature-determined pieces, shared by the dense kernel
+    and the components-mode planes builder: the per-(target row, entity
+    run) sticky collection state (exact OR regex sets, prefix mismatch
+    resets) and the per-(target row, op slot) operation hit (reference:
+    hierarchicalScope.ts:61-147)."""
+    em_ex_k = (
+        (c["t_ent_vals"][:, :, None] == r["r_ent_vals"][None, None, :])
+        & (c["t_ent_vals"][:, :, None] >= 0)
+        & ent_valid[None, None, :]
+    )  # [T, K_ENT, NR]
+    set_k = em_ex_k | rgx_hit  # regex set wins over reset
+    reset_k = pfx_neq & ~set_k
+
+    def _sticky_k(carry, inputs):
+        set_bit, reset_bit = inputs
+        state = jnp.where(set_bit, True, jnp.where(reset_bit, False, carry))
+        return state, state
+
+    _, coll_t = jax.lax.scan(
+        _sticky_k,
+        jnp.zeros(set_k.shape[:2], bool),
+        (jnp.moveaxis(set_k, 2, 0), jnp.moveaxis(reset_k, 2, 0)),
+    )
+    collect = jnp.moveaxis(coll_t, 0, 2).any(axis=1)  # [T, NR]
+    op_hit = (
+        (c["t_op_vals"][:, :, None] == r["r_op_vals"][None, None, :])
+        & (c["t_op_vals"][:, :, None] >= 0)
+        & (r["r_op_vals"][None, None, :] >= 0)
+    ).any(axis=1)  # [T, NOP]
+    return collect, op_hit
+
+
 def _subject_ok(c: dict, r: dict):
     """Subject matching per target row -> [T] bool (reference:
     checkSubjectMatches, accessController.ts:793-823).  Shared by the
@@ -443,13 +532,22 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     res_rg_d = no_res | (state_final_rg & ~deny_skip_rg)
 
     if components:
-        return {
+        out = {
             "sig_res_ex_p": res_ex_p,
             "sig_res_ex_d": res_ex_d,
             "sig_res_rg_p": res_rg_p,
             "sig_res_rg_d": res_rg_d,
             "sig_act_ok": act_ok,
         }
+        if with_hr:
+            # stage B's signature-determined parts — the owner side
+            # stays per-request (shared helper with the dense stage B)
+            collect, op_hit = _hr_collect_state(
+                c, r, rgx_hit, pfx_neq, ent_valid
+            )
+            out["sig_collect"] = collect
+            out["sig_op_hit"] = op_hit
+        return out
 
     base = sub_ok & act_ok
     tm_ex_p = base & res_ex_p
@@ -485,26 +583,9 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
         return out
     # collection per (target, entity slot, run) with sticky state like the
     # reference HR loop (exact OR regex sets, prefix mismatch resets,
-    # reference: hierarchicalScope.ts:61-124)
-    em_ex_k = (
-        (c["t_ent_vals"][:, :, None] == r["r_ent_vals"][None, None, :])
-        & (c["t_ent_vals"][:, :, None] >= 0)
-        & ent_valid[None, None, :]
-    )  # [T, K_ENT, NR]
-    set_k = em_ex_k | rgx_hit  # regex set wins over reset
-    reset_k = pfx_neq & ~set_k
-
-    def _sticky_k(carry, inputs):
-        set_bit, reset_bit = inputs
-        state = jnp.where(set_bit, True, jnp.where(reset_bit, False, carry))
-        return state, state
-
-    _, coll_t = jax.lax.scan(
-        _sticky_k,
-        jnp.zeros(set_k.shape[:2], bool),
-        (jnp.moveaxis(set_k, 2, 0), jnp.moveaxis(reset_k, 2, 0)),
-    )
-    collect = jnp.moveaxis(coll_t, 0, 2).any(axis=1)  # [T, NR]
+    # reference: hierarchicalScope.ts:61-124) — shared with the signature
+    # planes builder
+    collect, op_hit = _hr_collect_state(c, r, rgx_hit, pfx_neq, ent_valid)
 
     inst_valid = r["r_inst_valid"]  # [NI]
     inst_run = jnp.clip(r["r_inst_run"], 0, None)
@@ -518,57 +599,16 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     # owner pair checks against role associations / HR closure, factored
     # per distinct (role, scoping) vocab pair (compile.py hrv_*): the
     # membership sweeps over ra3/hr run at [RV, ...] instead of
-    # [T, ...], the NHR sweep becomes ONE boolean matmul
-    # (role-hit [RV, NHR] x inst-hit [NHR, Q] on the MXU), and the
-    # results gather back per target row via t_rs_idx.  Semantics are
+    # [T, ...], the NHR sweep becomes ONE boolean matmul on the MXU, and
+    # the results gather back per target row via t_rs_idx.  Semantics are
     # unchanged from the direct broadcast (reference:
     # hierarchicalScope.ts:165-245).
-    rv_role = c["hrv_role"]    # [RV]
-    rv_scope = c["hrv_scope"]  # [RV]
-    t_rs = c["t_rs_idx"]       # [T]
-    ra3 = r["r_ra3"]  # [NRA, 3]
-    ra3_valid = ra3[:, 1] >= 0
-    rs_hit3 = (
-        (rv_role[:, None] == ra3[None, :, 0])
-        & (rv_scope[:, None] == ra3[None, :, 1])
-        & ra3_valid[None, :]
-    )  # [RV, NRA]
-    ra2 = r["r_ra2"]
-    ra2_valid = ra2[:, 1] >= 0
-    ra2_ok_v = (
-        (rv_role[:, None] == ra2[None, :, 0])
-        & (rv_scope[:, None] == ra2[None, :, 1])
-        & ra2_valid[None, :]
-    ).any(axis=1)  # [RV]
-    hr = r["r_hr"]
-    hr_valid = hr[:, 1] >= 0
-    role_hit = (rv_role[:, None] == hr[None, :, 0]) & hr_valid[None, :]
+    t_rs = c["t_rs_idx"]  # [T]
+    owner_v = _make_owner_checks(c["hrv_role"], c["hrv_scope"], r)
 
     def owner_checks(owner_ent, owner_inst):
-        # owner_ent/owner_inst: [N, NOWN]; returns direct/hier [T, N]
-        N, NOWN = owner_inst.shape
-        q_ent = owner_ent.reshape(-1)    # [Q = N*NOWN]
-        q_inst = owner_inst.reshape(-1)
-        ent_match_v = (
-            rv_scope[:, None] == q_ent[None, :]
-        ) & (q_ent >= 0)[None, :]  # [RV, Q]
-        # direct: (role, scoping, owner-instance) in ra3
-        inst_hit3 = q_inst[:, None] == ra3[None, :, 2]  # [Q, NRA]
-        direct_cnt = jnp.matmul(
-            rs_hit3.astype(jnp.float32),
-            inst_hit3.astype(jnp.float32).T,
-        )  # [RV, Q]
-        direct_v = ent_match_v & (direct_cnt > 0)
-        # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
-        inst_hit = q_inst[:, None] == hr[None, :, 1]  # [Q, NHR]
-        hier_cnt = jnp.matmul(
-            role_hit.astype(jnp.float32),
-            inst_hit.astype(jnp.float32).T,
-        )  # [RV, Q]
-        hier_v = ent_match_v & (hier_cnt > 0) & ra2_ok_v[:, None]
-        direct = direct_v.reshape(-1, N, NOWN).any(axis=2)  # [RV, N]
-        hier = hier_v.reshape(-1, N, NOWN).any(axis=2)
-        return jnp.take(direct, t_rs, axis=0), jnp.take(hier, t_rs, axis=0)
+        direct_v, hier_v = owner_v(owner_ent, owner_inst)
+        return jnp.take(direct_v, t_rs, axis=0), jnp.take(hier_v, t_rs, axis=0)
 
     inst_direct, inst_hier = owner_checks(
         r["r_inst_owner_ent"], r["r_inst_owner_inst"]
@@ -577,11 +617,6 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     inst_bad = need_inst & ~inst_ok
 
     # operation-resource branch (reference: hierarchicalScope.ts:126-147)
-    op_hit = (
-        (c["t_op_vals"][:, :, None] == r["r_op_vals"][None, None, :])
-        & (c["t_op_vals"][:, :, None] >= 0)
-        & (r["r_op_vals"][None, None, :] >= 0)
-    ).any(axis=1)  # [T, NOP]
     op_missing = op_hit & (~r["r_op_present"] | ~r["r_op_has_owners"])[None, :]
     op_direct, op_hier = owner_checks(r["r_op_owner_ent"], r["r_op_owner_inst"])
     op_ok = op_direct | (c["t_hr_check"][:, None] & op_hier)
@@ -727,7 +762,8 @@ def _policy_gates(c: dict, r: dict, m: dict):
 
 
 def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
-                             cond_a, cond_c, pol_gate, set_gate):
+                             cond_a, cond_c, pol_gate, set_gate,
+                             pol_subject=None):
     """Flat-rule-axis variant of _combine_and_decide for the signature
     kernel: inputs arrive as [S, KP*KR] planes and the per-policy KR
     reductions run as reduce_windows, so batched callers avoid
@@ -746,6 +782,8 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
     abort_rule = reached & has_cond & cond_a & scope_f
     matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
     coll = matches & scope_f
+    if pol_subject is not None:  # policy-subject HR gate (reference :188-195)
+        coll = coll & jnp.repeat(pol_subject, KR, axis=1)
 
     m_pos = jnp.broadcast_to(
         jnp.arange(M, dtype=jnp.int32)[None, :], (S, M)

@@ -49,6 +49,7 @@ from .kernel import (
     _action_kind,
     _combine_and_decide_flat,
     _evaluate_one,
+    _make_owner_checks,
     _match_targets,
     _multi_entity_ok,
     _policy_gates_core,
@@ -70,6 +71,20 @@ _SIG_R_KEYS = [
     "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
     "r_n_entity_attrs", "r_n_ra", "r_acl_short",
 ]
+# additional per-row arrays when the tree carries HR-bearing targets
+# (stage B's owner side is per-request; its collection state is
+# per-signature)
+_SIG_R_KEYS_HR = _SIG_R_KEYS + [
+    "r_inst_run", "r_inst_valid", "r_inst_present", "r_inst_has_owners",
+    "r_inst_owner_ent", "r_inst_owner_inst",
+    "r_op_present", "r_op_has_owners", "r_op_owner_ent", "r_op_owner_inst",
+    "r_ra3", "r_ra2", "r_hr", "r_ctx_present",
+]
+# int32-packed columns that are semantically bool
+_SIG_BOOL_KEYS = {
+    "r_inst_valid", "r_inst_present", "r_inst_has_owners",
+    "r_op_present", "r_op_has_owners", "r_ctx_present",
+}
 
 _RULE_FIELDS = [
     "rule_valid", "rule_effect", "rule_cacheable_raw", "rule_cacheable_eff",
@@ -227,15 +242,15 @@ class PrefilteredKernel:
         self._bits_fn = None
         self._dense: DecisionKernel | None = None
         self._runs: dict[tuple, object] = {}
-        # signature-bit fast path: stage A's resource/action planes depend
-        # only on the (entity, operation, action) signature the batch is
-        # already grouped by, so they are precomputed once per signature
-        # and the per-row device work collapses to the subject fold plus
-        # the rule/policy stages.  Sound only when stage B is trivial for
-        # the whole tree (no row carries subjects + scoping entity) and the
-        # batch has no ACL pairs / request properties (those rows need the
-        # full per-row matcher).
-        self.sig_ok = not tree_needs_hr(compiled.arrays)
+        # signature-plane fast path: stage A's resource/action planes (and
+        # stage B's collection state / op hits, when the tree carries HR
+        # targets) depend only on the (entity, operation, action)
+        # signature the batch is already grouped by, so they are
+        # precomputed once per signature and the per-row device work
+        # collapses to the subject fold + owner checks + rule/policy
+        # stages.  Batches with ACL pairs / request properties fall back
+        # to the full per-row matcher.
+        self.needs_hr = tree_needs_hr(compiled.arrays)
         self.active = compiled.n_rules >= MIN_RULES
         if not self.active:
             if mesh is not None:
@@ -290,7 +305,8 @@ class PrefilteredKernel:
             self._runs[key] = run
         return run
 
-    def _sig_runner(self, schedule: tuple, needs_pairs: bool = True):
+    def _sig_runner(self, schedule: tuple, needs_pairs: bool = True,
+                    with_hr: bool = False):
         """The signature-plane kernel: stage A (resource/action target
         matching) is pre-gathered to rule/policy/set granularity per
         signature (_planes_for), so the per-row device work is pure
@@ -303,7 +319,7 @@ class PrefilteredKernel:
         host->device transfer (the TPU tunnel pays per-transfer latency —
         ~35 small puts per call were costing ~10x the compute), and the
         three outputs return stacked as one [3, B] readback."""
-        key = ("sig", schedule, needs_pairs)
+        key = ("sig", schedule, needs_pairs, with_hr)
         run = self._runs.get(key)
         if run is None:
             c_inv = self._c_inv
@@ -336,7 +352,8 @@ class PrefilteredKernel:
                     for k, w, tail in schedule:
                         v = row[offset:offset + w]
                         offset += w
-                        ra[k] = v.reshape(tail) if tail else v[0]
+                        v = v.reshape(tail) if tail else v[0]
+                        ra[k] = (v != 0) if k in _SIG_BOOL_KEYS else v
                     g = ra.pop("__g__")
                     c = {**c_inv,
                          **jax.tree_util.tree_map(lambda x: x[g], cs)}
@@ -374,6 +391,136 @@ class PrefilteredKernel:
                         rl_sub & (flat(sg["rl_ex"]) | flat(sg["rl_rg"]))
                     )
                     reached = flat(c["rule_valid"]) & tm_rule
+                    if with_hr:
+                        # stage B at plane granularity: collection state
+                        # and op hits are per-signature (sg planes); the
+                        # owner side is per-request via the shared vocab
+                        # owner checks (reference:
+                        # hierarchicalScope.ts:10-258)
+                        owner_v = _make_owner_checks(
+                            c["hrv_role"], c["hrv_scope"], rr
+                        )
+                        i_dir, i_hier = owner_v(
+                            rr["r_inst_owner_ent"], rr["r_inst_owner_inst"]
+                        )  # [RV, NI]
+                        o_dir, o_hier = owner_v(
+                            rr["r_op_owner_ent"], rr["r_op_owner_inst"]
+                        )  # [RV, NOP]
+                        ctx_ok = (
+                            rr["r_ctx_present"] & (rr["r_n_ra"] > 0)
+                        )
+                        run_idx = jnp.clip(rr["r_inst_run"], 0, None)
+                        need_base = rr["r_inst_valid"] & (
+                            rr["r_inst_run"] >= 0
+                        )  # [NI]
+                        miss_base = (
+                            ~rr["r_inst_present"]
+                            | ~rr["r_inst_has_owners"]
+                        )
+                        op_miss_base = (
+                            ~rr["r_op_present"] | ~rr["r_op_has_owners"]
+                        )
+                        NI = int(run_idx.shape[0])
+                        NOPc = int(op_miss_base.shape[0])
+                        packable = 2 * (NI + NOPc) <= 31
+
+                        if packable:
+                            # pack the per-(vocab, slot) owner verdicts
+                            # into one int32 per vocab row: the four
+                            # [.., NI]-wide plane gathers collapse to ONE
+                            # int gather + shift unpacks (gathers are the
+                            # slow path on TPU; see TPU_COMPAT.md)
+                            code = jnp.zeros(i_dir.shape[0], jnp.int32)
+                            for i in range(NI):
+                                code = code | (
+                                    i_dir[:, i].astype(jnp.int32) << i
+                                ) | (
+                                    i_hier[:, i].astype(jnp.int32)
+                                    << (NI + i)
+                                )
+                            for j in range(NOPc):
+                                code = code | (
+                                    o_dir[:, j].astype(jnp.int32)
+                                    << (2 * NI + j)
+                                ) | (
+                                    o_hier[:, j].astype(jnp.int32)
+                                    << (2 * NI + NOPc + j)
+                                )
+
+                        def hr_level(collect_p, op_hit_p, triv_p, rs_p,
+                                     hrchk_p):
+                            if not packable:
+                                need = jnp.take(
+                                    collect_p, run_idx, axis=-1
+                                ) & need_base
+                                inst_ok = jnp.take(i_dir, rs_p, axis=0) | (
+                                    hrchk_p[..., None]
+                                    & jnp.take(i_hier, rs_p, axis=0)
+                                )
+                                op_ok = jnp.take(o_dir, rs_p, axis=0) | (
+                                    hrchk_p[..., None]
+                                    & jnp.take(o_hier, rs_p, axis=0)
+                                )
+                                bad = (
+                                    (need & miss_base).any(-1)
+                                    | (need & ~inst_ok).any(-1)
+                                    | (op_hit_p & op_miss_base).any(-1)
+                                    | (op_hit_p & ~op_ok).any(-1)
+                                )
+                                return triv_p | (ctx_ok & ~bad)
+                            codes = jnp.take(code, rs_p, axis=0)
+                            bad = jnp.zeros(rs_p.shape, bool)
+                            NR_runs = collect_p.shape[-1]
+                            for i in range(NI):
+                                # collect at this instance's run: a
+                                # static select over NR, not a gather
+                                coll_i = jnp.zeros(rs_p.shape, bool)
+                                for nr in range(NR_runs):
+                                    coll_i = coll_i | (
+                                        (run_idx[i] == nr)
+                                        & collect_p[..., nr]
+                                    )
+                                need_i = coll_i & need_base[i]
+                                dir_i = (((codes >> i) & 1) == 1)
+                                hier_i = (
+                                    ((codes >> (NI + i)) & 1) == 1
+                                )
+                                ok_i = dir_i | (hrchk_p & hier_i)
+                                bad = bad | (
+                                    need_i & (miss_base[i] | ~ok_i)
+                                )
+                            for j in range(NOPc):
+                                dir_j = (
+                                    ((codes >> (2 * NI + j)) & 1) == 1
+                                )
+                                hier_j = (
+                                    ((codes >> (2 * NI + NOPc + j)) & 1)
+                                    == 1
+                                )
+                                ok_j = dir_j | (hrchk_p & hier_j)
+                                bad = bad | (
+                                    op_hit_p[..., j]
+                                    & (op_miss_base[j] | ~ok_j)
+                                )
+                            return triv_p | (ctx_ok & ~bad)
+
+                        M_ = KP_ * KR_
+                        hr_rule = hr_level(
+                            sg["rl_collect"].reshape(S_, M_, -1),
+                            sg["rl_op_hit"].reshape(S_, M_, -1),
+                            flat(sg["rl_triv"]), flat(sg["rl_rs"]),
+                            flat(sg["rl_hrchk"]),
+                        )  # [S, M]
+                        hr_pol = hr_level(
+                            sg["pl_collect"], sg["pl_op_hit"],
+                            sg["pl_triv"], sg["pl_rs"], sg["pl_hrchk"],
+                        )  # [S, KP]
+                        reached = reached & (~rht_f | hr_rule)
+                        pol_subject = (
+                            ~c["pol_has_subjects"] | hr_pol
+                        )  # [S, KP]
+                    else:
+                        pol_subject = None
                     kind = _action_kind(c, rr)
                     short = rr["r_acl_short"]
                     acl_row = flat(sg["rl_skip"]) | (short == 1) | (
@@ -405,6 +552,7 @@ class PrefilteredKernel:
                     return _combine_and_decide_flat(
                         c, reached, acl_rule, has_cond, cond_t, cond_a,
                         cond_c, pol_gate, set_gate,
+                        pol_subject=pol_subject,
                     )
 
                 return jnp.stack(jax.vmap(one)(mega))
@@ -486,13 +634,14 @@ class PrefilteredKernel:
             }
             if self._bits_fn is None:
                 c_inv = self._c_inv
+                with_hr = self.needs_hr
 
                 def bits_fn(cs, rr):
                     def one(g, r_row):
                         c = {**c_inv,
                              **jax.tree_util.tree_map(lambda x: x[g], cs)}
                         comp = _match_targets(
-                            c, r_row, with_hr=False, components=True
+                            c, r_row, with_hr=with_hr, components=True
                         )
                         act = comp["sig_act_ok"]
                         rt = c["rule_target"]
@@ -508,7 +657,41 @@ class PrefilteredKernel:
                         multi_ok = _multi_entity_ok(
                             c, r_row["r_ent_vals"], r_row["r_ent_valid"]
                         )
+                        hr_planes = {}
+                        if with_hr:
+                            hr_triv = (c["t_n_subjects"] == 0) | ~c[
+                                "t_has_scoping"
+                            ]
+                            hr_planes = {
+                                "rl_collect": jnp.take(
+                                    comp["sig_collect"], rt, axis=0
+                                ),
+                                "rl_op_hit": jnp.take(
+                                    comp["sig_op_hit"], rt, axis=0
+                                ),
+                                "rl_triv": jnp.take(hr_triv, rt, axis=0),
+                                "rl_rs": jnp.take(
+                                    c["t_rs_idx"], rt, axis=0
+                                ),
+                                "rl_hrchk": jnp.take(
+                                    c["t_hr_check"], rt, axis=0
+                                ),
+                                "pl_collect": jnp.take(
+                                    comp["sig_collect"], pt, axis=0
+                                ),
+                                "pl_op_hit": jnp.take(
+                                    comp["sig_op_hit"], pt, axis=0
+                                ),
+                                "pl_triv": jnp.take(hr_triv, pt, axis=0),
+                                "pl_rs": jnp.take(
+                                    c["t_rs_idx"], pt, axis=0
+                                ),
+                                "pl_hrchk": jnp.take(
+                                    c["t_hr_check"], pt, axis=0
+                                ),
+                            }
                         return {
+                            **hr_planes,
                             "rl_ex": jnp.where(
                                 deny, g_(comp["sig_res_ex_d"], rt),
                                 g_(comp["sig_res_ex_p"], rt)
@@ -616,11 +799,10 @@ class PrefilteredKernel:
         NOP = ops.shape[1]
         NACT = acts.shape[1]
 
-        # signature-bit eligibility: trivial stage B tree-wide, and no ACL
-        # pairs / request properties in this batch (see __init__)
+        # signature-plane eligibility: no ACL pairs / request properties
+        # in this batch (those rows need the full per-row matcher)
         use_sig = (
-            self.sig_ok
-            and not bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any())
+            not bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any())
             and not bool(np.asarray(batch.arrays["r_has_props"]).any())
         )
 
@@ -763,9 +945,10 @@ class PrefilteredKernel:
                 rgx_np, pfx_np,
             )
             # pack the whole per-row side into ONE int32 transfer
+            r_keys = _SIG_R_KEYS_HR if self.needs_hr else _SIG_R_KEYS
             schedule = [("__g__", 1, ())]
             parts = [g_idx.astype(np.int32)[:, None]]
-            for k in _SIG_R_KEYS:
+            for k in r_keys:
                 a = pad_lead(np.asarray(batch.arrays[k]))
                 tail = a.shape[1:]
                 w = int(np.prod(tail)) if tail else 1
@@ -788,7 +971,9 @@ class PrefilteredKernel:
                 (~np.asarray(stacked["t_has_role"])
                  & (np.asarray(stacked["t_n_subjects"]) > 0)).any()
             )
-            run = self._sig_runner(tuple(schedule), needs_pairs)
+            run = self._sig_runner(
+                tuple(schedule), needs_pairs, with_hr=self.needs_hr
+            )
             cs = {k: v for k, v in stacked.items() if k in _SIG_C_KEYS}
             out = np.asarray(run(cs, bits, jnp.asarray(mega)))
             return tuple(out[i][:B] for i in range(3))

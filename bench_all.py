@@ -523,9 +523,11 @@ def bench_hr_deep():
 # ------------------------------------------------- config 5: 100k-rule stress
 
 
-def _stress_engine(n_rules: int):
+def _stress_engine(n_rules: int, scoped: bool = False):
     """Synthetic tree: deny-overrides set of permit-overrides policies,
-    role/entity/action-targeted rules with interleaved PERMIT/DENY."""
+    role/entity/action-targeted rules with interleaved PERMIT/DENY.
+    ``scoped=True`` adds a roleScopingEntity to every rule's role subject
+    (stage B non-trivial tree-wide: the enterprise shape)."""
     from access_control_srv_tpu.core.loader import load_policy_sets
     from access_control_srv_tpu.core import AccessController
     from access_control_srv_tpu.models import Urns
@@ -543,13 +545,17 @@ def _stress_engine(n_rules: int):
         rules = []
         for q in range(per_policy):
             entity = entities[(p * 31 + q) % len(entities)]
+            subjects = [{"id": urns["role"], "value": f"role-{rid % 97}"}]
+            if scoped:
+                subjects.append({
+                    "id": urns["roleScopingEntity"],
+                    "value": ORG,
+                })
             rules.append(
                 {
                     "id": f"r{rid}",
                     "target": {
-                        "subjects": [
-                            {"id": urns["role"], "value": f"role-{rid % 97}"}
-                        ],
+                        "subjects": subjects,
                         "resources": [{"id": urns["entity"], "value": entity}],
                         "actions": [
                             {"id": urns["actionID"],
@@ -663,6 +669,73 @@ def bench_stress():
     )
 
 
+def bench_stress_hr():
+    """The enterprise shape: a large rule corpus where every rule is
+    role-scoped (hierarchical owner matching on every row) — stage B runs
+    through the signature path's per-request vocab owner checks while the
+    collection state rides the per-signature planes."""
+    from access_control_srv_tpu.models import Urns
+    from access_control_srv_tpu.ops import (
+        PrefilteredKernel,
+        compile_policies,
+        encode_requests,
+    )
+    from tests.utils import build_request
+
+    urns = Urns()
+    n_rules = int(os.environ.get("STRESS_HR_RULES", 100_000))
+    total = int(os.environ.get("STRESS_HR_TOTAL", 1 << 16))
+    chunk = int(os.environ.get("STRESS_HR_CHUNK", 8192))
+    t0 = time.perf_counter()
+    engine, actual_rules = _stress_engine(n_rules, scoped=True)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+    compile_s = time.perf_counter() - t0
+    kernel = PrefilteredKernel(compiled)
+    assert kernel.needs_hr
+
+    rng = np.random.default_rng(13)
+    orgs = [f"org-{j}" for j in range(12)]
+    requests = []
+    for i in range(chunk):
+        role = f"role-{int(rng.integers(108))}"
+        k = int(rng.integers(72))
+        entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+        tree = [{"id": orgs[0], "role": role,
+                 "children": [{"id": o} for o in orgs[1:8]]}]
+        owner = orgs[int(rng.integers(len(orgs)))]  # ~2/3 inside the tree
+        requests.append(build_request(
+            subject_id=f"u{i}", subject_role=role,
+            role_scoping_entity=ORG, role_scoping_instance=orgs[0],
+            resource_type=entity, resource_id=f"res-{i}",
+            action_type=[urns["read"], urns["modify"], urns["create"],
+                         urns["delete"]][i % 4],
+            owner_indicatory_entity=ORG, owner_instance=owner,
+            hierarchical_scopes=tree,
+        ))
+    batch = encode_requests(requests, compiled)
+    dec, _, _ = kernel.evaluate(batch)  # warmup + sig planes
+    assert kernel._bits, "HR signature path must engage"
+    code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    for i in range(0, chunk, max(1, chunk // 16)):
+        expected = engine.is_allowed(requests[i])
+        assert dec[i] == code[expected.decision], (i, dec[i], expected.decision)
+
+    iters = max(1, total // chunk)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kernel.evaluate(batch)
+    elapsed = time.perf_counter() - t0
+    return _result(
+        f"isAllowed decisions/sec/chip ({actual_rules}-rule stress + HR scoping)",
+        chunk * iters / elapsed,
+        "decisions/s",
+        {"rules": actual_rules, "batch": chunk, "iters": iters,
+         "host_compile_s": round(compile_s, 2),
+         "eligible_pct": round(100.0 * float(batch.eligible.mean()), 1)},
+    )
+
+
 HOST_ONLY = {"scalar", "wia"}
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -704,7 +777,7 @@ def main():
             }
 
     which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
-                             "hr-deep", "stress"]
+                             "hr-deep", "stress", "stress-hr"]
     if backend is None:
         global ACCEL_OK
         ACCEL_OK = False
@@ -724,6 +797,7 @@ def main():
         "hr": bench_hr_conditions,
         "hr-deep": bench_hr_deep,
         "stress": bench_stress,
+        "stress-hr": bench_stress_hr,
     }
     for name in which:
         row = fns[name]()

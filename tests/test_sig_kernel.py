@@ -6,9 +6,11 @@ on every eligible shape: exact + regex entities (foreign-namespace prefix
 resets), multi-entity ordered runs, operations, conditions and aborts,
 all three combining algorithms.
 
-Eligibility (use_sig): the tree has no HR-bearing target rows and the
-batch carries no ACL pairs / request properties; anything else must fall
-back to the full per-row matcher with identical results.
+Eligibility (use_sig): the batch carries no ACL pairs / request
+properties; anything else must fall back to the full per-row matcher with
+identical results.  HR-bearing trees ride the signature path too: their
+collection state / op hits are per-signature planes, the owner checks are
+per-request vocab matmuls.
 """
 
 import copy
@@ -125,7 +127,7 @@ def test_sig_path_engages_and_matches_oracle():
         if not compiled.supported:
             continue
         kern = force_active(PrefilteredKernel(compiled))
-        if not kern.sig_ok:
+        if kern.needs_hr:
             continue
         trees_with_sig += 1
         requests = _sig_requests(rng, 64)
@@ -153,7 +155,7 @@ def test_sig_path_matches_dense_kernel_exactly():
             continue
         dense = DecisionKernel(compiled)
         kern = force_active(PrefilteredKernel(compiled))
-        if not kern.sig_ok:
+        if kern.needs_hr:
             continue
         requests = _sig_requests(rng, 96)
         batch = encode_requests(requests, compiled)
@@ -195,16 +197,56 @@ def test_prop_rows_fall_back_with_identical_results():
     assert len(kern._bits) == n_bits_before
 
 
-def test_hr_tree_disables_sig_path():
-    engine = AccessController()
+def test_hr_tree_uses_sig_path_and_matches_oracle():
+    """HR-bearing trees now take the signature path too: the collection
+    state and op hits are per-signature planes, the owner checks are the
+    shared per-request vocab matmuls.  Decisions must equal the oracle
+    across owner placements (direct, hierarchical, miss) and both the
+    dense kernel."""
+    import random as _random
+
     from .utils import fixture
     from access_control_srv_tpu.core import populate
 
+    engine = AccessController()
     populate(engine, fixture("role_scopes.yml"))
     compiled = compile_policies(engine.policy_sets, engine.urns)
     assert compiled.supported
-    kern = PrefilteredKernel(compiled)
-    assert not kern.sig_ok
+    kern = force_active(PrefilteredKernel(compiled))
+    assert kern.needs_hr
+
+    LOC = "urn:restorecommerce:acs:model:location.Location"
+    rng = _random.Random(21)
+    requests = []
+    owners = ["Org1", "Org2", "Org3", "SuperOrg1", "otherOrg"]
+    for i in range(48):
+        requests.append(
+            build_request(
+                subject_id=f"user-{i % 16}",
+                subject_role=["member", "manager", "guest"][i % 3],
+                role_scoping_entity=ORG,
+                role_scoping_instance=rng.choice(owners),
+                resource_type=LOC if i % 2 else ORG,
+                resource_id=f"L{i}",
+                action_type=(
+                    "urn:restorecommerce:acs:names:action:read"
+                    if i % 3 else
+                    "urn:restorecommerce:acs:names:action:modify"
+                ),
+                owner_indicatory_entity=ORG,
+                owner_instance=rng.choice(owners),
+            )
+        )
+    n, batch = _run_differential(engine, compiled, kern, requests)
+    assert n > 30
+    assert kern._bits, "HR sig path must engage"
+    dense = DecisionKernel(compiled)
+    d1, c1, s1 = dense.evaluate(batch)
+    d2, c2, s2 = kern.evaluate(batch)
+    el = np.asarray(batch.eligible)
+    assert (d1[el] == d2[el]).all()
+    assert (c1[el] == c2[el]).all()
+    assert (s1[el] == s2[el]).all()
 
 
 def test_conditions_and_aborts_through_sig_path():
@@ -218,7 +260,7 @@ def test_conditions_and_aborts_through_sig_path():
     compiled = compile_policies(engine.policy_sets, engine.urns)
     assert compiled.supported
     kern = force_active(PrefilteredKernel(compiled))
-    assert kern.sig_ok, "conditions fixture must stay HR-trivial"
+    assert not kern.needs_hr, "conditions fixture must stay HR-trivial"
     rng = random.Random(3)
     requests = _sig_requests(rng, 48)
     # guaranteed abort row: matches r_self_modify's target but its context
