@@ -1,6 +1,6 @@
-"""Signature-bit kernel differential: the prefiltered kernel's fast path
-precomputes stage A's resource/action planes per resource signature
-(ops/prefilter.py _bits_for) and folds only the subject side per row.
+"""Signature-plane kernel differential: the prefiltered kernel's fast
+path precomputes stage A's resource/action planes per resource signature
+(ops/prefilter.py _planes_for) and folds only the subject side per row.
 Decisions must be bit-identical to the scalar oracle and the dense kernel
 on every eligible shape: exact + regex entities (foreign-namespace prefix
 resets), multi-entity ordered runs, operations, conditions and aborts,
