@@ -76,32 +76,65 @@ class ReverseQueryKernel:
         # version-pinned snapshot (the evaluator publishes one alongside
         # the compiled arrays) — copying again would be pure waste
         self.sets = copy.deepcopy(sets) if copy_tree else sets
-        c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+        # RuleRQ carriers are request-independent (id/target/effect/
+        # condition/cacheable: pure rule data), so one shared instance per
+        # rule serves every request this kernel answers — object
+        # construction was the wia-large host-assembly bottleneck.  The
+        # cache lives exactly as long as the version-pinned snapshot.
+        self._rule_rq_cache: dict[int, RuleRQ] = {}
+        self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+        self._runs: dict[tuple, object] = {}
 
-        def run(batch_arrays, rgx_set, pfx_neq):
-            def one(ra, rs, pn):
-                rr = {**ra, "rgx_set": rs, "pfx_neq": pn}
-                m = _match_targets(c, rr, with_hr=False, wia=True)
-                return {k: m[k] for k in WIA_KEYS}
+    def _runner(self, schedule: tuple):
+        """Jitted per packed-schedule: the per-row arrays travel as ONE
+        int32 transfer and the six wia mask planes return as one stacked
+        readback (the TPU tunnel pays per transfer — see TPU_COMPAT.md)."""
+        import jax
+        import jax.numpy as jnp
 
-            return jax.vmap(one, in_axes=({k: 0 for k in batch_arrays},
-                                          None, None))(
-                batch_arrays, rgx_set, pfx_neq
-            )
+        run = self._runs.get(schedule)
+        if run is None:
+            c = self._c
 
-        self._run = jax.jit(run)
+            def run(mega, rgx_set, pfx_neq):
+                def one(row):
+                    offset = 0
+                    rr = {"rgx_set": rgx_set, "pfx_neq": pfx_neq}
+                    for k, w, tail, is_bool in schedule:
+                        v = row[offset:offset + w]
+                        offset += w
+                        v = v.reshape(tail) if tail else v[0]
+                        rr[k] = (v != 0) if is_bool else v
+                    m = _match_targets(c, rr, with_hr=False, wia=True)
+                    return jnp.stack([m[k] for k in WIA_KEYS])
+
+                return jax.vmap(one)(mega)
+
+            run = jax.jit(run)
+            self._runs[schedule] = run
+        return run
 
     def evaluate(self, batch: RequestBatch) -> dict[str, np.ndarray]:
         """Returns {key: [B, T] bool} for the six wia vectors."""
         import jax.numpy as jnp
 
         b, _, e_bucket, pad_lead = lead_padding(batch)
-        out = self._run(
-            {k: jnp.asarray(pad_lead(v)) for k, v in batch.arrays.items()},
+        schedule = []
+        parts = []
+        for k, v in batch.arrays.items():
+            a = pad_lead(np.asarray(v))
+            tail = a.shape[1:]
+            w = int(np.prod(tail)) if tail else 1
+            parts.append(a.reshape(a.shape[0], w).astype(np.int32))
+            schedule.append((k, w, tuple(tail), bool(a.dtype == np.bool_)))
+        mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
+        run = self._runner(tuple(schedule))
+        out = np.asarray(run(
+            jnp.asarray(mega),
             jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
             jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
-        )
-        return {k: np.asarray(v)[:b] for k, v in out.items()}
+        ))  # [B, 6, T]
+        return {k: out[:b, i] for i, k in enumerate(WIA_KEYS)}
 
 
 def _rule_match_cubes(compiled: CompiledPolicies, masks: dict):
@@ -130,7 +163,7 @@ def _rule_match_cubes(compiled: CompiledPolicies, masks: dict):
 
 def _assemble(
     engine, compiled: CompiledPolicies, sets, request, m,
-    rule_match=None, rule_maskful=None,
+    rule_match=None, rule_maskful=None, rule_rq_cache=None,
 ) -> ReverseQuery:
     """Replay of AccessController.what_is_allowed (engine.py:373-499,
     reference accessController.ts:326-427) with device match vectors.
@@ -239,14 +272,23 @@ def _assemble(
                                 matches = tm(rrow, rule.target,
                                              rule.effect, True)
                         if rule.target is None or matches:
-                            policy_rq.rules.append(RuleRQ(
-                                id=rule.id,
-                                target=rule.target,
-                                effect=rule.effect,
-                                condition=rule.condition,
-                                context_query=rule.context_query,
-                                evaluation_cacheable=rule.evaluation_cacheable,
-                            ))
+                            rq = None
+                            if rule_rq_cache is not None:
+                                rq = rule_rq_cache.get(id(rule))
+                            if rq is None:
+                                rq = RuleRQ(
+                                    id=rule.id,
+                                    target=rule.target,
+                                    effect=rule.effect,
+                                    condition=rule.condition,
+                                    context_query=rule.context_query,
+                                    evaluation_cacheable=(
+                                        rule.evaluation_cacheable
+                                    ),
+                                )
+                                if rule_rq_cache is not None:
+                                    rule_rq_cache[id(rule)] = rq
+                            policy_rq.rules.append(rq)
                     if policy_rq.effect or (
                         not policy_rq.effect and policy_rq.rules
                     ):
@@ -287,5 +329,6 @@ def what_is_allowed_batch(
         out.append(_assemble(
             engine, compiled, kernel.sets, request, m,
             rule_match[b], rule_maskful[b],
+            rule_rq_cache=kernel._rule_rq_cache,
         ))
     return out
