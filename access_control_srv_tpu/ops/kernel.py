@@ -70,6 +70,31 @@ def pow2_bucket(n: int, floor: int = 8) -> int:
     return max(floor, 1 << max(n - 1, 1).bit_length())
 
 
+def half_pow2_bucket(n: int, floor: int = 8) -> int:
+    """Smallest value >= n of the form 2^k or 1.5 * 2^k (min ``floor``):
+    twice the bucket density of pow2_bucket, capping padding waste at 33%
+    instead of 100% while still bounding distinct compile shapes."""
+    p = pow2_bucket(n, floor)
+    return p - p // 4 if n <= p - p // 4 and p - p // 4 >= floor else p
+
+
+def pack_rule_key(pos, effect, cacheable):
+    """Combine-reduction key: rule position in the high bits, (effect,
+    cacheable) payload in the low 3, so position min/max reductions carry
+    the selected rule's effect and cacheable bits with them and no
+    post-reduction gather is needed (a [S, KP]-at-[S, M] take_along_axis
+    here was ~90% of the 100k-rule stress batch on TPU — round-5 profile).
+    Shared with the rule-sharded kernel's packed cross-device reductions
+    (parallel/rule_shard.py); position ordering is preserved because
+    positions are distinct and occupy the high bits."""
+    return (pos << 3) | (effect << 1) | cacheable.astype(jnp.int32)
+
+
+def unpack_rule_key(key):
+    """(effect, cacheable) payload of a pack_rule_key winner."""
+    return (key >> 1) & 3, key & 1
+
+
 def pad_cols(a: np.ndarray, width: int) -> np.ndarray:
     """Zero-pad the second axis out to `width` (conditions are [n_cond, B];
     regex matrices are [W, E])."""
@@ -788,6 +813,7 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
     m_pos = jnp.broadcast_to(
         jnp.arange(M, dtype=jnp.int32)[None, :], (S, M)
     )
+    code_f = pack_rule_key(m_pos, re_f, cach_eff_f)
 
     def win_min(x):
         return jax.lax.reduce_window(
@@ -799,10 +825,10 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
             x, jnp.int32(-1), jax.lax.max, (1, KR), (1, KR), "VALID"
         )
 
-    first_deny = win_min(jnp.where(coll & (re_f == 2), m_pos, BIG))
-    first_permit = win_min(jnp.where(coll & (re_f == 1), m_pos, BIG))
-    first_coll = win_min(jnp.where(coll, m_pos, BIG))
-    last_coll = win_max(jnp.where(coll, m_pos, -1))
+    first_deny = win_min(jnp.where(coll & (re_f == 2), code_f, BIG))
+    first_permit = win_min(jnp.where(coll & (re_f == 1), code_f, BIG))
+    first_coll = win_min(jnp.where(coll, code_f, BIG))
+    last_coll = win_max(jnp.where(coll, code_f, -1))
     any_coll = win_max(coll.astype(jnp.int32)) > 0
 
     sel_do = jnp.where(first_deny < BIG, first_deny, last_coll)
@@ -812,9 +838,7 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
         [sel_do, sel_po, first_coll],
         default=jnp.zeros_like(sel_do),
     )
-    sel_c = jnp.clip(sel, 0, M - 1)
-    rule_eff_sel = jnp.take_along_axis(re_f, sel_c, axis=1)
-    rule_cach_sel = jnp.take_along_axis(cach_eff_f, sel_c, axis=1)
+    rule_eff_sel, rule_cach_sel = unpack_rule_key(sel)
 
     no_rules_contrib = (
         c["pol_valid"]

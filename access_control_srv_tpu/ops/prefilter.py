@@ -54,6 +54,7 @@ from .kernel import (
     _multi_entity_ok,
     _policy_gates_core,
     _rule_conditions,
+    half_pow2_bucket,
     lead_padding,
     pad_cols,
     pow2_bucket,
@@ -307,18 +308,27 @@ class PrefilteredKernel:
 
     def _sig_runner(self, schedule: tuple, needs_pairs: bool = True,
                     with_hr: bool = False):
-        """The signature-plane kernel: stage A (resource/action target
-        matching) is pre-gathered to rule/policy/set granularity per
-        signature (_planes_for), so the per-row device work is pure
-        elementwise — subject folds against [KP, KR]-shaped planes plus
-        stages C-G — with NO per-row gathers (the [B, T]-at-[S,KP,KR]
-        gathers were the dominant cost on TPU: ~44ms each per batch).
+        """The signature-plane kernel in GROUP-DENSE slot layout: stage A
+        (resource/action target matching) is pre-gathered to rule/policy/
+        set granularity per signature (_planes_for), and the batch arrives
+        sorted by signature and packed into ``[NSLOT, R]`` row slots where
+        every slot's rows share ONE group — so the group tables/planes are
+        gathered once per *slot* and every hot op is a broadcast against
+        the slot's ``[R, ...]`` rows.
+
+        Why not gather per row: XLA re-reads a gathered operand per fused
+        consumer, so per-row ``x[g]`` indexing of the ``[G, S, KP, KR]``
+        stacks cost ~35 GB of HBM traffic per 16k-row batch on the 100k-
+        rule tree (measured via compiled.cost_analysis, round 5) — ~22
+        consumers x [B, M] int32.  Slot granularity cuts that to
+        ``NSLOT/B`` (~2%) of the per-row traffic and leaves the per-row
+        work pure elementwise.
 
         ``schedule`` describes the packed per-row int32 buffer: every
         request array + the transposed condition bits travel in ONE
         host->device transfer (the TPU tunnel pays per-transfer latency —
         ~35 small puts per call were costing ~10x the compute), and the
-        three outputs return stacked as one [3, B] readback."""
+        three outputs return stacked as one [NSLOT, 3, R] readback."""
         key = ("sig", schedule, needs_pairs, with_hr)
         run = self._runs.get(key)
         if run is None:
@@ -327,26 +337,51 @@ class PrefilteredKernel:
             def sub_fold(r, n_sub, has_role, role, sub_ids, sub_vals):
                 # checkSubjectMatches at plane granularity (reference:
                 # accessController.ts:793-823); broadcasts over the
-                # plane's leading shape.  ``needs_pairs`` is a static
-                # property of the signature set: when every subject-
-                # bearing row is role-targeted, the (id, value) pair
-                # subset check — the widest intermediate of the runner —
-                # is skipped entirely.
-                role_ok = (
-                    (role[..., None] == r["r_roles"]) & (r["r_roles"] >= 0)
-                ).any(-1)
+                # plane's leading shape.  The small request-side dims
+                # (roles, subject pairs) are unrolled as Python loops so
+                # every materialized intermediate keeps the plane's M-flat
+                # trailing dim — a [.., M, k] comparison with k<128 pads
+                # to the TPU's (8, 128) tile, inflating HBM traffic up to
+                # 256x (measured: 54 GB/batch on the 100k tree, round 5).
+                # ``needs_pairs`` is a static property of the signature
+                # set: when every subject-bearing row is role-targeted,
+                # the pair subset check is skipped entirely.
+                KRR = int(r["r_roles"].shape[0])
+                role_ok = jnp.zeros(role.shape, bool)
+                for j in range(KRR):
+                    role_ok = role_ok | (
+                        (role == r["r_roles"][j]) & (r["r_roles"][j] >= 0)
+                    )
                 if not needs_pairs:
                     return (n_sub == 0) | role_ok
-                eq = (
-                    (sub_ids[..., :, None] == r["r_sub_ids"])
-                    & (sub_vals[..., :, None] == r["r_sub_vals"])
-                    & (r["r_sub_ids"] >= 0)
-                )
-                pairs_ok = ((sub_ids < 0) | eq.any(-1)).all(-1)
+                KSt = int(sub_ids.shape[-1])
+                KSr = int(r["r_sub_ids"].shape[0])
+                pairs_ok = jnp.ones(n_sub.shape, bool)
+                for i in range(KSt):
+                    sid = sub_ids[..., i]
+                    sval = sub_vals[..., i]
+                    hit = jnp.zeros(sid.shape, bool)
+                    for j in range(KSr):
+                        hit = hit | (
+                            (sid == r["r_sub_ids"][j])
+                            & (sval == r["r_sub_vals"][j])
+                            & (r["r_sub_ids"][j] >= 0)
+                        )
+                    pairs_ok = pairs_ok & ((sid < 0) | hit)
                 return (n_sub == 0) | jnp.where(has_role, role_ok, pairs_ok)
 
-            def run(cs, planes, mega):
-                def one(row):
+            def run(cs, planes, slot_g, mega):
+                def slot_fn(g, rows):
+                    # ONE gather of the group tables/planes per slot; the
+                    # inner vmap's rows all share them as broadcasts
+                    c = {**c_inv,
+                         **jax.tree_util.tree_map(lambda x: x[g], cs)}
+                    sg = jax.tree_util.tree_map(lambda x: x[g], planes)
+                    return jnp.stack(
+                        jax.vmap(lambda row: one(c, sg, row))(rows)
+                    )
+
+                def one(c, sg, row):
                     offset = 0
                     ra = {}
                     for k, w, tail in schedule:
@@ -354,10 +389,6 @@ class PrefilteredKernel:
                         offset += w
                         v = v.reshape(tail) if tail else v[0]
                         ra[k] = (v != 0) if k in _SIG_BOOL_KEYS else v
-                    g = ra.pop("__g__")
-                    c = {**c_inv,
-                         **jax.tree_util.tree_map(lambda x: x[g], cs)}
-                    sg = jax.tree_util.tree_map(lambda x: x[g], planes)
                     rr = {
                         **ra,
                         "cond_true": ra["cond_true"] != 0,
@@ -527,10 +558,10 @@ class PrefilteredKernel:
                         (short == 0) & (rr["r_n_ra"] > 0) & (kind > 0)
                     )
                     acl_rule = ~rht_f | acl_row
-                    has_cond, cond_t, cond_a, cond_c = _rule_conditions(c, rr)
-                    has_cond, cond_t, cond_a, cond_c = (
-                        flat(has_cond), flat(cond_t), flat(cond_a),
-                        flat(cond_c),
+                    # condition wiring on the flat rule axis (a [S, KP, KR]
+                    # take would pad the KR-16 tail to the 128-lane tile)
+                    has_cond, cond_t, cond_a, cond_c = _rule_conditions(
+                        {"rule_cond": flat(c["rule_cond"])}, rr
                     )
 
                     # policy gates via the shared core (reference:
@@ -555,7 +586,7 @@ class PrefilteredKernel:
                         pol_subject=pol_subject,
                     )
 
-                return jnp.stack(jax.vmap(one)(mega))
+                return jax.vmap(slot_fn)(slot_g, mega)  # [NSLOT, 3, R]
 
             if self.mesh is None:
                 run = jax.jit(run)
@@ -564,10 +595,10 @@ class PrefilteredKernel:
 
                 repl = NamedSharding(self.mesh, P())
                 data = NamedSharding(self.mesh, P(self.axis))
-                out = NamedSharding(self.mesh, P(None, self.axis))
+                out = NamedSharding(self.mesh, P(self.axis))
                 run = jax.jit(
                     run,
-                    in_shardings=(repl, repl, data),
+                    in_shardings=(repl, repl, data, data),
                     out_shardings=out,
                 )
             self._runs[key] = run
@@ -938,33 +969,72 @@ class PrefilteredKernel:
                                 a.dtype)
                 return np.concatenate([a, fill], axis=0)
 
-        g_idx = pad_lead(inv.astype(np.int32).reshape(B))
         if use_sig:
             bits = self._planes_for(
                 tuple(keys), groups, stacked, (NR, NOP, NACT),
                 rgx_np, pfx_np,
             )
-            # pack the whole per-row side into ONE int32 transfer
+            # pack the whole per-row side into ONE int32 buffer [B, W]
             r_keys = _SIG_R_KEYS_HR if self.needs_hr else _SIG_R_KEYS
-            schedule = [("__g__", 1, ())]
-            parts = [g_idx.astype(np.int32)[:, None]]
+            schedule = []
+            parts = []
             for k in r_keys:
-                a = pad_lead(np.asarray(batch.arrays[k]))
+                a = np.asarray(batch.arrays[k])
                 tail = a.shape[1:]
                 w = int(np.prod(tail)) if tail else 1
-                parts.append(a.reshape(a.shape[0], w).astype(np.int32))
+                parts.append(a.reshape(B, w).astype(np.int32))
                 schedule.append((k, w, tuple(tail)))
             C = batch.cond_true.shape[0]
             for nm, arr in (("cond_true", batch.cond_true),
                             ("cond_abort", batch.cond_abort),
                             ("cond_code", batch.cond_code)):
                 parts.append(
-                    np.ascontiguousarray(
-                        pad_cols(arr, parts[0].shape[0]).T
-                    ).astype(np.int32)
+                    np.ascontiguousarray(np.asarray(arr).T).astype(np.int32)
                 )
                 schedule.append((nm, C, (C,)))
-            mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
+            mega_rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
+            W = mega_rows.shape[1]
+
+            # group-dense slot layout (see _sig_runner): rows sorted by
+            # signature, packed into [NSLOT, R] slots that each share one
+            # group; padding is bounded by G * R extra rows and oversized
+            # groups simply span multiple slots.  R derives from BUCKETED
+            # batch/group counts only (and nslot pads to half-pow2
+            # buckets), so signature-mix skew cannot multiply compiled
+            # (ns_pad, R) shape variants of the heavy runner
+            G = uniq.shape[0]
+            gb = pow2_bucket(G, floor=1)
+            R = min(4096, pow2_bucket(
+                max(8, 2 * pow2_bucket(B) // gb), floor=8,
+            ))
+            # near-unique signature mixes (G approaching B) would inflate
+            # the slot grid by the R floor; cap total padded rows at
+            # ~4x the bucketed batch so adversarial traffic degrades
+            # bounded (8-row sublane tile is the hard floor)
+            R = min(R, max(8, pow2_bucket(
+                4 * pow2_bucket(B) // gb, floor=8,
+            )))
+            row_order = np.argsort(inv, kind="stable")
+            counts = np.bincount(inv, minlength=G)
+            slots_per_g = -(-counts // R)
+            slot_base = np.concatenate(([0], np.cumsum(slots_per_g)))
+            nslot = int(slot_base[-1])
+            ns_pad = half_pow2_bucket(nslot, floor=8)
+            if self.mesh is not None:
+                n_data = self.mesh.shape[self.axis]
+                if ns_pad % n_data:
+                    ns_pad = -(-ns_pad // n_data) * n_data
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            rk = np.arange(B) - starts[inv[row_order]]
+            slot_idx = (slot_base[inv[row_order]] + rk // R).astype(np.int64)
+            col = (rk % R).astype(np.int64)
+            slot_g = np.zeros(ns_pad, np.int32)
+            slot_g[:nslot] = np.repeat(
+                np.arange(G, dtype=np.int32), slots_per_g
+            )
+            mega = np.zeros((ns_pad, R, W), np.int32)
+            mega[slot_idx, col] = mega_rows[row_order]
+
             # static: does ANY subject-bearing target row in this stack
             # match by attribute pairs instead of role?
             needs_pairs = bool(
@@ -975,15 +1045,18 @@ class PrefilteredKernel:
                 tuple(schedule), needs_pairs, with_hr=self.needs_hr
             )
             cs = {k: v for k, v in stacked.items() if k in _SIG_C_KEYS}
-            out = np.asarray(run(cs, bits, jnp.asarray(mega)))
-            return tuple(out[i][:B] for i in range(3))
+            out = np.asarray(run(cs, bits, slot_g, mega))  # [NS, 3, R]
+            res = out[slot_idx, :, col]  # [B, 3] in sorted-row order
+            final = np.empty((3, B), np.int32)
+            final[:, row_order] = res.T
+            return tuple(final[i] for i in range(3))
         run = self._runner(
             bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),
             tree_needs_hr(stacked),
         )
         out = run(
             stacked,
-            jnp.asarray(g_idx),
+            jnp.asarray(pad_lead(inv.astype(np.int32).reshape(B))),
             {k: jnp.asarray(pad_lead(np.asarray(v)))
              for k, v in batch.arrays.items()},
             jnp.asarray(pad_cols(rgx_np, e_bucket)),

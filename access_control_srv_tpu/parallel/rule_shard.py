@@ -41,6 +41,8 @@ from ..ops.kernel import (
     _match_targets,
     _policy_gates,
     _rule_predicates,
+    pack_rule_key,
+    unpack_rule_key,
 )
 
 # target-table fields partitioned per shard (see compile.py _TargetTable)
@@ -149,17 +151,19 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
     coll = matches & pol_subject[:, :, None] & scope  # [S, KP, KR_local]
 
     KRl = coll.shape[2]
-    # GLOBAL rule positions inside each (set, policy)
+    # GLOBAL rule positions inside each (set, policy), packed with the
+    # (effect, cacheable) payload via the shared combine-reduction key
     pos = (kr_offset + jnp.arange(KRl))[None, None, :]
-    payload = c["rule_effect"] * 2 + c["rule_cacheable_eff"].astype(jnp.int32)
+    key_lo = pack_rule_key(pos, c["rule_effect"], c["rule_cacheable_eff"])
+    key_hi = pack_rule_key(pos + 1, c["rule_effect"], c["rule_cacheable_eff"])
     BIGKEY = jnp.int32(2_000_000_000)
 
     def pmin_key(mask):
-        local = jnp.min(jnp.where(mask, pos * 8 + payload, BIGKEY), axis=2)
+        local = jnp.min(jnp.where(mask, key_lo, BIGKEY), axis=2)
         return jax.lax.pmin(local, model_axis)
 
     def pmax_key(mask):
-        local = jnp.max(jnp.where(mask, (pos + 1) * 8 + payload, 0), axis=2)
+        local = jnp.max(jnp.where(mask, key_hi, 0), axis=2)
         return jax.lax.pmax(local, model_axis)
 
     k_first_deny = pmin_key(coll & (c["rule_effect"] == 2))
@@ -180,8 +184,7 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
         [sel_key_do, sel_key_po, sel_key_fa],
         default=jnp.zeros_like(sel_key_do),
     )
-    rule_eff_sel = (sel_key // 2) % 4
-    rule_cach_sel = sel_key % 2
+    rule_eff_sel, rule_cach_sel = unpack_rule_key(sel_key)
 
     no_rules_contrib = (
         c["pol_valid"]
