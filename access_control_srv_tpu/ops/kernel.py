@@ -564,6 +564,24 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
             "sig_res_rg_d": res_rg_d,
             "sig_act_ok": act_ok,
         }
+        if wia:
+            # whatIsAllowed-mode RESOURCE planes at signature granularity
+            # (reference: accessController.ts:592-640): everything but the
+            # subject fold is (entity, operation, action, has-props)-
+            # determined, so the reverse-query kernel caches these per
+            # signature and folds subjects host-side (ops/reverse.py)
+            wia_fail_ex = has_props & ~r_has_props & ent_any_ex
+            wia_fail_rg = has_props & ~r_has_props & state_any_rg
+            out["sig_wia_ex_p"] = no_res | (
+                (ent_any_ex | opm) & ~wia_fail_ex
+            )
+            out["sig_wia_ex_d"] = no_res | ent_any_ex | opm
+            out["sig_wia_rg_p"] = no_res | (
+                state_final_rg & ~wia_fail_rg
+            )
+            out["sig_wia_rg_d"] = no_res | state_final_rg
+            out["sig_maybe_ex"] = has_props & ent_any_ex
+            out["sig_maybe_rg"] = has_props & state_any_rg
         if with_hr:
             # stage B's signature-determined parts — the owner side
             # stays per-request (shared helper with the dense stage B)

@@ -35,11 +35,19 @@ from ..models.model import PolicyRQ, PolicySetRQ, ReverseQuery, RuleRQ
 from ..models.model import OperationStatus
 from .compile import CompiledPolicies
 from .encode import RequestBatch, encode_requests
-from .kernel import _match_targets, lead_padding, pad_cols
+from .kernel import _match_targets, lead_padding, pad_cols, pow2_bucket
 
 WIA_KEYS = [
     "tm_wia_ex_p", "tm_wia_ex_d", "tm_wia_rg_p", "tm_wia_rg_d",
     "maybe_mask_ex", "maybe_mask_rg",
+]
+
+# per-signature RESOURCE planes emitted by the components+wia device
+# program (kernel._match_targets), cached per signature; the subject fold
+# happens host-side per row
+_PLANE_KEYS = [
+    "sig_wia_ex_p", "sig_wia_ex_d", "sig_wia_rg_p", "sig_wia_rg_d",
+    "sig_maybe_ex", "sig_maybe_rg", "sig_act_ok",
 ]
 
 # below this rule count the scalar reverse-query walk beats the device
@@ -84,11 +92,15 @@ class ReverseQueryKernel:
         self._rule_rq_cache: dict[int, RuleRQ] = {}
         self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
         self._runs: dict[tuple, object] = {}
+        self._plane_cache: dict[tuple, np.ndarray] = {}
 
     def _runner(self, schedule: tuple):
-        """Jitted per packed-schedule: the per-row arrays travel as ONE
-        int32 transfer and the six wia mask planes return as one stacked
-        readback (the TPU tunnel pays per transfer — see TPU_COMPAT.md)."""
+        """Jitted per packed-schedule: representative rows (one per NEW
+        resource signature) travel as ONE int32 transfer and the
+        per-signature RESOURCE planes return as one stacked readback.
+        The subject fold is applied host-side, so this program runs only
+        on signature-cache misses — steady-state reverse queries touch
+        the device not at all."""
         import jax
         import jax.numpy as jnp
 
@@ -105,8 +117,10 @@ class ReverseQueryKernel:
                         offset += w
                         v = v.reshape(tail) if tail else v[0]
                         rr[k] = (v != 0) if is_bool else v
-                    m = _match_targets(c, rr, with_hr=False, wia=True)
-                    return jnp.stack([m[k] for k in WIA_KEYS])
+                    m = _match_targets(
+                        c, rr, with_hr=False, wia=True, components=True
+                    )
+                    return jnp.stack([m[k] for k in _PLANE_KEYS])
 
                 return jax.vmap(one)(mega)
 
@@ -114,27 +128,147 @@ class ReverseQueryKernel:
             self._runs[schedule] = run
         return run
 
-    def evaluate(self, batch: RequestBatch) -> dict[str, np.ndarray]:
-        """Returns {key: [B, T] bool} for the six wia vectors."""
+    def _signature_planes(self, batch: RequestBatch, sig, first_idx):
+        """[G, NK, T] resource planes for the batch's distinct signatures,
+        via the plane cache; misses are computed in one device dispatch
+        over the first batch row of each missing signature (the planes
+        depend only on signature fields, so any representative row
+        works)."""
         import jax.numpy as jnp
 
-        b, _, e_bucket, pad_lead = lead_padding(batch)
-        schedule = []
-        parts = []
-        for k, v in batch.arrays.items():
-            a = pad_lead(np.asarray(v))
-            tail = a.shape[1:]
-            w = int(np.prod(tail)) if tail else 1
-            parts.append(a.reshape(a.shape[0], w).astype(np.int32))
-            schedule.append((k, w, tuple(tail), bool(a.dtype == np.bool_)))
-        mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
-        run = self._runner(tuple(schedule))
-        out = np.asarray(run(
-            jnp.asarray(mega),
-            jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
-            jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
-        ))  # [B, 6, T]
-        return {k: out[:b, i] for i, k in enumerate(WIA_KEYS)}
+        G = sig.shape[0]
+        T = self.compiled.arrays["t_role"].shape[0]
+        NK = len(_PLANE_KEYS)
+        planes = np.empty((G, NK, T), bool)
+        missing = []
+        gkeys = []
+        for g in range(G):
+            gk = (sig[g].tobytes(), self.compiled.version)
+            gkeys.append(gk)
+            got = self._plane_cache.get(gk)
+            if got is None:
+                missing.append(g)
+            else:
+                planes[g] = got
+        if missing:
+            _, _, e_bucket, _ = lead_padding(batch)
+            rows = np.asarray([first_idx[g] for g in missing])
+            nm_pad = pow2_bucket(len(rows), floor=8)
+            schedule = []
+            parts = []
+            for k, v in batch.arrays.items():
+                a = np.asarray(v)[rows]
+                tail = a.shape[1:]
+                w = int(np.prod(tail)) if tail else 1
+                part = a.reshape(a.shape[0], w).astype(np.int32)
+                if nm_pad != part.shape[0]:
+                    part = np.concatenate(
+                        [part,
+                         np.zeros((nm_pad - part.shape[0], w), np.int32)],
+                        axis=0,
+                    )
+                parts.append(part)
+                schedule.append((k, w, tuple(tail),
+                                 bool(a.dtype == np.bool_)))
+            mega = np.ascontiguousarray(np.concatenate(parts, axis=1))
+            run = self._runner(tuple(schedule))
+            out = np.asarray(run(
+                jnp.asarray(mega),
+                jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
+                jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
+            ))  # [nm_pad, NK, T]
+            for j, g in enumerate(missing):
+                planes[g] = out[j]
+                if len(self._plane_cache) >= 4096:
+                    self._plane_cache.pop(next(iter(self._plane_cache)))
+                # own copy: caching a view of ``planes`` (or ``out``)
+                # would pin the whole per-batch buffer for the cache's
+                # lifetime
+                self._plane_cache[gkeys[g]] = planes[g].copy()
+        return planes
+
+    def evaluate(self, batch: RequestBatch) -> dict[str, np.ndarray]:
+        """Returns {key: [B, T] bool} for the six wia vectors.
+
+        Split: per-signature RESOURCE planes from the device (cached —
+        see kernel._match_targets components+wia), per-row subject fold
+        in numpy.  The former [B, T]-per-row device program paid the
+        TPU's (8, 128) tile padding on every small-trailing-dim
+        intermediate and was ~90% of reverse-query wall time on the
+        1000-rule tree (round-5 profile)."""
+        a_ = batch.arrays
+        ents = np.asarray(a_["r_ent_vals"])
+        valid = np.asarray(a_["r_ent_valid"])
+        ops = np.asarray(a_["r_op_vals"])
+        act_ids = np.asarray(a_["r_act_ids"])
+        acts = np.asarray(a_["r_act_vals"])
+        hasp = np.asarray(a_["r_has_props"])
+        B = ents.shape[0]
+
+        # ordered entity runs (sticky regex state is order-sensitive) +
+        # sorted ops + sorted action pairs + the request has-props bit
+        # (it flips the wia PERMIT property-fail, reference :592-615)
+        ents_m = np.where(valid, ents, -1)
+        pair_key = (act_ids.astype(np.int64) << 32) | (
+            acts.astype(np.int64) & 0xFFFFFFFF
+        )
+        order = np.argsort(pair_key, axis=1, kind="stable")
+        sig = np.concatenate(
+            [ents_m, np.sort(ops, 1),
+             np.take_along_axis(act_ids, order, 1),
+             np.take_along_axis(acts, order, 1),
+             hasp.astype(np.int32).reshape(B, 1)],
+            axis=1,
+        )
+        uniq, first_idx, inv = np.unique(
+            sig, axis=0, return_index=True, return_inverse=True
+        )
+        inv = inv.reshape(B)
+        planes = self._signature_planes(batch, uniq, first_idx)
+        row_planes = planes[inv]  # [B, NK, T]
+        pk = {k: i for i, k in enumerate(_PLANE_KEYS)}
+
+        # subject fold in numpy (reference: checkSubjectMatches
+        # :793-823); T x batch is bounded by the decision-kernel contract
+        # (the masks dict below is [B, T] x 6 either way)
+        c = self.compiled.arrays
+        t_role = c["t_role"]
+        roles = np.asarray(a_["r_roles"])
+        role_ok = (
+            (t_role[None, :, None] == roles[:, None, :]).any(-1)
+            & (t_role >= 0)[None, :]
+        )  # [B, T]
+        # the pair-subset fold is the widest intermediate
+        # ([B, T, KS, KSr]); it only decides USER-targeted rows
+        # (subjects without a role attribute), so it runs compacted to
+        # that row subset — zero-width for the common role-only tree
+        pair_rows = np.nonzero(
+            ~c["t_has_role"] & (c["t_n_subjects"] > 0)
+        )[0]
+        sub_ok = (c["t_n_subjects"] == 0)[None] | (
+            c["t_has_role"][None] & role_ok
+        )
+        if pair_rows.size:
+            ts_ids = c["t_sub_ids"][pair_rows]
+            ts_vals = c["t_sub_vals"][pair_rows]
+            sub_ids = np.asarray(a_["r_sub_ids"])
+            sub_vals = np.asarray(a_["r_sub_vals"])
+            eq = (
+                (ts_ids[None, :, :, None] == sub_ids[:, None, None, :])
+                & (ts_vals[None, :, :, None] == sub_vals[:, None, None, :])
+                & (sub_ids[:, None, None, :] >= 0)
+            )  # [B, Tp, KS, KSr]
+            pairs_ok = ((ts_ids[None] < 0) | eq.any(-1)).all(-1)
+            sub_ok[:, pair_rows] |= pairs_ok
+        base = sub_ok & row_planes[:, pk["sig_act_ok"]]
+        return {
+            "tm_wia_ex_p": base & row_planes[:, pk["sig_wia_ex_p"]],
+            "tm_wia_ex_d": base & row_planes[:, pk["sig_wia_ex_d"]],
+            "tm_wia_rg_p": base & row_planes[:, pk["sig_wia_rg_p"]],
+            "tm_wia_rg_d": base & row_planes[:, pk["sig_wia_rg_d"]],
+            "maybe_mask_ex": row_planes[:, pk["sig_maybe_ex"]],
+            "maybe_mask_rg": row_planes[:, pk["sig_maybe_rg"]],
+        }
 
 
 def _rule_match_cubes(compiled: CompiledPolicies, masks: dict):
@@ -163,7 +297,7 @@ def _rule_match_cubes(compiled: CompiledPolicies, masks: dict):
 
 def _assemble(
     engine, compiled: CompiledPolicies, sets, request, m,
-    rule_match=None, rule_maskful=None, rule_rq_cache=None,
+    match_lists=None, maskful_any=None, rule_rq_cache=None,
 ) -> ReverseQuery:
     """Replay of AccessController.what_is_allowed (engine.py:373-499,
     reference accessController.ts:326-427) with device match vectors.
@@ -244,17 +378,15 @@ def _assemble(
                     )
                     rules_list = list(policy.combinables.values())
                     fast = (
-                        rule_match is not None
-                        and not rule_maskful[s, kp, :len(rules_list)].any()
+                        match_lists is not None
+                        and not maskful_any[s, kp]
                     )
                     if fast:
                         # no rule of this policy can append obligations for
                         # this request: collect matches wholesale from the
-                        # precomputed cube (identical verdicts, no side
-                        # effects to order)
-                        matching = np.nonzero(
-                            rule_match[s, kp, :len(rules_list)]
-                        )[0]
+                        # pre-grouped (s, kp) -> [kr] lists (identical
+                        # verdicts, no side effects to order)
+                        matching = match_lists.get((s, kp), ())
                         rule_iter = ((kr, rules_list[kr]) for kr in matching)
                     else:
                         rule_iter = enumerate(rules_list)
@@ -320,15 +452,28 @@ def what_is_allowed_batch(
         )
     masks = kernel.evaluate(batch)
     rule_match, rule_maskful = _rule_match_cubes(compiled, masks)
+    # one vectorized pass over the whole batch replaces per-request
+    # nonzero/any calls in the assembly loop: matching (b, s, kp, kr)
+    # tuples grouped per request, and the per-policy "any maskful rule"
+    # bit reduced once
+    maskful_any = rule_maskful.any(axis=3)  # [B, S, KP]
+    mb, ms, mp, mk = np.nonzero(rule_match)
+    bounds = np.searchsorted(mb, np.arange(len(requests) + 1))
     out = []
     for b, request in enumerate(requests):
         if not batch.eligible[b]:
             out.append(engine.what_is_allowed(request))
             continue
         m = {k: v[b] for k, v in masks.items()}
+        lo, hi = bounds[b], bounds[b + 1]
+        match_lists: dict[tuple[int, int], list[int]] = {}
+        for j in range(lo, hi):
+            match_lists.setdefault(
+                (int(ms[j]), int(mp[j])), []
+            ).append(int(mk[j]))
         out.append(_assemble(
             engine, compiled, kernel.sets, request, m,
-            rule_match[b], rule_maskful[b],
+            match_lists, maskful_any[b],
             rule_rq_cache=kernel._rule_rq_cache,
         ))
     return out

@@ -279,7 +279,7 @@ def bench_wia_large():
     kernel = ReverseQueryKernel(compiled, engine.policy_sets)
 
     rng = random.Random(3)
-    n = int(os.environ.get("WIA_LARGE_N", 512))
+    n = int(os.environ.get("WIA_LARGE_N", 2048))
     requests = []
     for i in range(n):
         k = rng.randint(0, 63)
