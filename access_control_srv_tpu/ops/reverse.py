@@ -37,11 +37,6 @@ from .compile import CompiledPolicies
 from .encode import RequestBatch, encode_requests
 from .kernel import _match_targets, lead_padding, pad_cols, pow2_bucket
 
-WIA_KEYS = [
-    "tm_wia_ex_p", "tm_wia_ex_d", "tm_wia_rg_p", "tm_wia_rg_d",
-    "maybe_mask_ex", "maybe_mask_rg",
-]
-
 # per-signature RESOURCE planes emitted by the components+wia device
 # program (kernel._match_targets), cached per signature; the subject fold
 # happens host-side per row
@@ -206,6 +201,9 @@ class ReverseQueryKernel:
         B = ents.shape[0]
 
         # ordered entity runs (sticky regex state is order-sensitive) +
+        # the validity bits (a VALID slot whose value interned to
+        # ABSENT=-1 — e.g. a None-valued entity attribute — still drives
+        # regex/prefix state and must not collide with an absent slot) +
         # sorted ops + sorted action pairs + the request has-props bit
         # (it flips the wia PERMIT property-fail, reference :592-615)
         ents_m = np.where(valid, ents, -1)
@@ -214,7 +212,7 @@ class ReverseQueryKernel:
         )
         order = np.argsort(pair_key, axis=1, kind="stable")
         sig = np.concatenate(
-            [ents_m, np.sort(ops, 1),
+            [ents_m, valid.astype(np.int32), np.sort(ops, 1),
              np.take_along_axis(act_ids, order, 1),
              np.take_along_axis(acts, order, 1),
              hasp.astype(np.int32).reshape(B, 1)],

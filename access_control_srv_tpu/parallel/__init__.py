@@ -1,5 +1,5 @@
 """Device-mesh sharding for the batched evaluator."""
 
-from .mesh import ShardedDecisionKernel, make_mesh, pad_batch
+from .mesh import ShardedDecisionKernel, make_mesh, make_mesh2, pad_batch
 
-__all__ = ["ShardedDecisionKernel", "make_mesh", "pad_batch"]
+__all__ = ["ShardedDecisionKernel", "make_mesh", "make_mesh2", "pad_batch"]

@@ -9,8 +9,10 @@ sharded batch.
 
 The kernel is pure and shape-static, so sharding is expressed entirely with
 ``jax.sharding.NamedSharding`` on the batch axis — XLA inserts the
-collectives.  A rule-axis (model-parallel) shard_map variant is the planned
-extension for trees too large to replicate per chip.
+collectives.  For trees too large to replicate per chip, the rule-axis
+(model-parallel) variant lives in parallel/rule_shard.py and is reachable
+from config via ``parallel:model_devices`` (make_mesh2 builds the 2-axis
+data x model mesh).
 """
 
 from __future__ import annotations
@@ -32,6 +34,26 @@ def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def make_mesh2(
+    n_data: int, n_model: int,
+    data_axis: str = "data", model_axis: str = "model",
+) -> Mesh:
+    """Two-axis (data x model) mesh for the rule-sharded kernel: requests
+    shard over ``data_axis``, the rule axis of the compiled policy tensors
+    over ``model_axis`` (parallel/rule_shard.py).  Built from the first
+    ``n_data * n_model`` devices; ICI-adjacent devices land on the model
+    axis (the per-(set, policy) packed-key reductions ride it)."""
+    devices = jax.devices()
+    need = n_data * n_model
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (data {n_data} x model {n_model}); "
+            f"only {len(devices)} available"
+        )
+    grid = np.array(devices[:need]).reshape(n_data, n_model)
+    return Mesh(grid, (data_axis, model_axis))
 
 
 def pad_batch(arrays: dict, B: int, multiple: int) -> tuple[dict, int]:

@@ -39,6 +39,7 @@ class HybridEvaluator:
         telemetry=None,
         mesh=None,
         mesh_axis: str = "data",
+        model_axis: str | None = None,
     ):
         self.engine = engine
         self.backend = backend
@@ -49,9 +50,13 @@ class HybridEvaluator:
         # ``mesh_axis`` while policy tensors replicate — the serving-path
         # multi-chip layout (the reference scales by running N stateless
         # replicas behind a load balancer, src/worker.ts:161-198; here one
-        # process drives N chips)
+        # process drives N chips).  With ``model_axis`` set (a 2-axis
+        # mesh from parallel.make_mesh2), the RULE axis of the compiled
+        # tensors shards over it too — trees too large to replicate per
+        # chip serve through parallel/rule_shard.RuleShardedKernel.
         self.mesh = mesh
         self.mesh_axis = mesh_axis
+        self.model_axis = model_axis
         self._version = 0
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
@@ -87,15 +92,31 @@ class HybridEvaluator:
             )
             kernel = None
             if compiled.supported and compiled.n_rules > 0:
-                # PrefilteredKernel is a drop-in DecisionKernel that keeps
-                # per-request work O(matching rules) on large trees and
-                # delegates to the dense kernel below MIN_RULES
-                from ..ops.prefilter import PrefilteredKernel
+                if self.model_axis is not None and self.mesh is not None:
+                    # rule-axis sharding (config: parallel:model_devices):
+                    # the compiled tensors partition over the model axis,
+                    # requests over the data axis.  Evaluator-level path
+                    # counters (kernel/oracle rows) still record via
+                    # _count_path; only PrefilteredKernel's internal
+                    # cache counters have no sharded equivalent.
+                    from ..parallel.rule_shard import RuleShardedKernel
 
-                kernel = PrefilteredKernel(
-                    compiled, mesh=self.mesh, axis=self.mesh_axis,
-                    telemetry=self.telemetry,
-                )
+                    kernel = RuleShardedKernel(
+                        compiled, self.mesh,
+                        data_axis=self.mesh_axis,
+                        model_axis=self.model_axis,
+                    )
+                else:
+                    # PrefilteredKernel is a drop-in DecisionKernel that
+                    # keeps per-request work O(matching rules) on large
+                    # trees and delegates to the dense kernel below
+                    # MIN_RULES
+                    from ..ops.prefilter import PrefilteredKernel
+
+                    kernel = PrefilteredKernel(
+                        compiled, mesh=self.mesh, axis=self.mesh_axis,
+                        telemetry=self.telemetry,
+                    )
             native_encoder = self._make_native_encoder(compiled, kernel)
             with self._lock:
                 if version >= self._version:  # drop stale compiles

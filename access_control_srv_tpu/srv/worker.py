@@ -146,34 +146,75 @@ class Worker:
             self.engine.create_resource_adapter(adapter_cfg)
         # multi-chip serving: `parallel:data_devices` (int, or "all")
         # builds a data-parallel mesh the evaluator shards request batches
-        # over; unset keeps single-device dispatch.  Touching jax.devices()
-        # initializes the backend, so the mesh is only built when asked for.
+        # over; `parallel:model_devices` (int > 1) additionally shards the
+        # RULE axis of the compiled policy tensors over a second mesh axis
+        # (parallel/rule_shard.py — for trees too large to replicate per
+        # chip), composable with data_devices into a 2-axis mesh.  Unset
+        # keeps single-device dispatch.  Touching jax.devices() initializes
+        # the backend, so the mesh is only built when asked for.
         mesh = None
-        n_req = cfg.get("parallel:data_devices")
-        if n_req:
+        model_axis = None
+
+        def parse_devices(key):
+            n_req = cfg.get(key)
+            if not n_req:
+                return None
             if isinstance(n_req, str):
                 n_req = n_req.strip().lower()
             if n_req in ("all", "-1", -1):
-                n_req = -1
+                return -1
+            try:
+                n_req = int(n_req)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{key} must be a positive integer, -1, or 'all'; "
+                    f"got {n_req!r}"
+                ) from None
+            if n_req <= 0:
+                raise ValueError(
+                    f"{key} must be a positive integer, -1, or 'all'; "
+                    f"got {n_req!r}"
+                )
+            return n_req
+
+        n_data_req = parse_devices("parallel:data_devices")
+        n_model_req = parse_devices("parallel:model_devices")
+        if n_model_req == -1:
+            raise ValueError(
+                "parallel:model_devices must be an explicit integer "
+                "(the rule-axis shard count is a layout choice, not "
+                "'all available')"
+            )
+        if n_model_req and n_model_req > 1:
+            import jax
+
+            from ..parallel import make_mesh2
+
+            avail = len(jax.devices())
+            if n_data_req in (None, -1):
+                n_data = max(1, avail // n_model_req)
             else:
-                try:
-                    n_req = int(n_req)
-                except (TypeError, ValueError):
-                    raise ValueError(
-                        "parallel:data_devices must be a positive integer, "
-                        f"-1, or 'all'; got {n_req!r}"
-                    ) from None
-                if n_req <= 0:
-                    raise ValueError(
-                        "parallel:data_devices must be a positive integer, "
-                        f"-1, or 'all'; got {n_req!r}"
-                    )
+                # same clamp-to-available contract as the single-axis path
+                n_data = max(1, min(n_data_req, avail // n_model_req))
+            data_axis = cfg.get("parallel:axis", "data")
+            model_axis = cfg.get("parallel:model_axis", "model")
+            mesh = make_mesh2(
+                n_data, n_model_req,
+                data_axis=data_axis, model_axis=model_axis,
+            )
+            self.logger.info(
+                "rule-sharded mesh active",
+                extra={"data_devices": n_data,
+                       "model_devices": n_model_req,
+                       "available": avail},
+            )
+        elif n_data_req:
             import jax
 
             from ..parallel import make_mesh
 
             avail = len(jax.devices())
-            n = avail if n_req == -1 else min(n_req, avail)
+            n = avail if n_data_req == -1 else min(n_data_req, avail)
             mesh = make_mesh(n, axis=cfg.get("parallel:axis", "data"))
             self.logger.info(
                 "data-parallel mesh active",
@@ -188,6 +229,7 @@ class Worker:
             telemetry=self.telemetry,
             mesh=mesh,
             mesh_axis=cfg.get("parallel:axis", "data"),
+            model_axis=model_axis,
         )
 
         # policy store with self-authorization hook; the hook consults the

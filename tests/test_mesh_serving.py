@@ -194,3 +194,97 @@ def test_grpc_batch_over_mesh(rig):
     finally:
         client.close()
         server.stop()
+
+
+def make_sharded_worker(model_devices, data_devices=None):
+    parallel = {"model_devices": model_devices}
+    if data_devices is not None:
+        parallel["data_devices"] = data_devices
+    return Worker().start(
+        {
+            "policies": {"type": "database"},
+            "parallel": parallel,
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+        }
+    )
+
+
+def test_model_devices_builds_rule_sharded_kernel():
+    """Config-only toggle: `parallel:model_devices` routes serving through
+    the rule-axis sharded kernel (parallel/rule_shard.py) on a 2-axis
+    data x model mesh, decisions identical to single-device."""
+    from access_control_srv_tpu.parallel.rule_shard import RuleShardedKernel
+
+    worker = make_sharded_worker(model_devices=4, data_devices=2)
+    try:
+        assert worker.mesh is not None
+        assert worker.mesh.shape == {"data": 2, "model": 4}
+        assert isinstance(worker.evaluator._kernel, RuleShardedKernel)
+        reqs = batch_requests(24)
+        out = worker.evaluator.is_allowed_batch(reqs)
+        oracle = [worker.engine.is_allowed(r).decision for r in reqs]
+        assert [r.decision for r in out] == oracle
+    finally:
+        worker.stop()
+
+
+def test_model_devices_defaults_data_axis_to_remaining():
+    worker = make_sharded_worker(model_devices=2)
+    try:
+        assert worker.mesh.shape["model"] == 2
+        assert worker.mesh.shape["data"] == len(jax.devices()) // 2
+    finally:
+        worker.stop()
+
+
+def test_model_devices_survives_hot_mutation():
+    """A CRUD-triggered recompile rebuilds the RULE-SHARDED kernel (fresh
+    partitioning over the model axis) and the new rule's decisions flow
+    through it."""
+    from access_control_srv_tpu.parallel.rule_shard import RuleShardedKernel
+
+    worker = make_sharded_worker(model_devices=4, data_devices=2)
+    try:
+        reqs = batch_requests(16)
+        before = worker.evaluator.is_allowed_batch(reqs)
+        assert before[1].decision == "INDETERMINATE"
+        rule_service = worker.store.get_resource_service("rule")
+        rule_service.create(
+            [
+                {
+                    "id": "shard-hot-rule",
+                    "name": "hot",
+                    "effect": "PERMIT",
+                    "target": {
+                        "subjects": [
+                            {"id": URNS["role"], "value": "ordinary-user"}
+                        ],
+                        "resources": [{"id": URNS["entity"], "value": ORG}],
+                        "actions": [],
+                    },
+                }
+            ],
+            subject=None,
+        )
+        policy_service = worker.store.get_resource_service("policy")
+        doc = dict(policy_service.read()["items"][0]["payload"])
+        doc["rules"] = list(doc.get("rules") or []) + ["shard-hot-rule"]
+        res = policy_service.update([doc], subject=None)
+        assert res["operation_status"]["code"] == 200, res
+        kernel = worker.evaluator._kernel
+        assert isinstance(kernel, RuleShardedKernel)
+        out = worker.evaluator.is_allowed_batch(reqs)
+        oracle = [worker.engine.is_allowed(r).decision for r in reqs]
+        assert [r.decision for r in out] == oracle
+        assert out[1].decision == "PERMIT"
+    finally:
+        worker.stop()
+
+
+def test_model_devices_all_rejected():
+    with pytest.raises(ValueError, match="parallel:model_devices"):
+        make_sharded_worker(model_devices="all")
