@@ -349,7 +349,14 @@ class ResourceService:
 
     def _emit(self, event: str, doc: dict) -> None:
         if self.topic is not None:
-            self.topic.emit(event, doc)
+            # the origin id lets a PolicyReplicator on this worker skip
+            # its own frames when the broker streams them back (the
+            # mutation was applied locally at CRUD time); an offset-based
+            # guard would race the broker, which fans a frame out to
+            # subscribers BEFORE answering the emit RPC
+            self.topic.emit(
+                event, {"payload": doc, "origin": self.store.origin}
+            )
 
     # ----------------------------------------------------------------- CRUD
 
@@ -486,6 +493,11 @@ class PolicyStore:
             for kind in ("rule", "policy", "policy_set")
         }
 
+        # unique per store instance: stamps emitted CRUD frames so a
+        # replicator can distinguish this worker's own mutations from
+        # remote ones (srv/store.PolicyReplicator)
+        self.origin = uuid.uuid4().hex
+
     def get_resource_service(self, kind: str) -> ResourceService:
         return self.services[kind]
 
@@ -538,3 +550,108 @@ class PolicyStore:
         self.services["policy"].super_upsert(policy_docs, sync=False)
         self.services["policy_set"].super_upsert(policy_set_docs, sync=False)
         self.load()
+
+
+class PolicyReplicator:
+    """Shared mutable policy state across workers, over the broker's CRUD
+    topic logs.
+
+    The reference gets multi-replica policy storage from a shared ArangoDB
+    (cfg/config.json database.main) — every replica reads one durable
+    store, and in-memory trees are per-replica caches.  Here the durable
+    shared store IS the broker's journaled CRUD log: every mutation a
+    worker serves is already emitted to ``io.restorecommerce.{kind}s.
+    resource`` (ResourceService._emit); this replicator subscribes each
+    worker to those topics, replays the full log at boot (idempotent
+    upserts/deletes converge to the log's final state) and applies live
+    frames from OTHER workers to the local collections + engine tree, so
+    N workers serve one mutable policy state without restarts.
+
+    Apply path never re-emits (no event loops); the worker's own frames
+    carry its PolicyStore.origin stamp and are skipped (they were applied
+    locally at CRUD time).  Tree recompose + evaluator recompile are
+    debounced so a replay burst costs one compile, not one per event.
+    Concurrent writers use last-frame-wins per document — the same
+    semantics concurrent replicas get from the reference's shared Arango.
+    """
+
+    def __init__(self, store: PolicyStore, bus, logger=None,
+                 debounce_s: float = 0.05):
+        self.store = store
+        self.bus = bus
+        self.logger = logger
+        self.debounce_s = debounce_s
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._stopped = False
+        self._applied = 0
+        self._topics = {
+            self.store.services[kind].topic.name: kind
+            for kind in ("rule", "policy", "policy_set")
+            if self.store.services[kind].topic is not None
+        }
+
+    def start(self) -> "PolicyReplicator":
+        for topic_name in self._topics:
+            self.bus.topic(topic_name).on(self._on_event, starting_offset=0)
+        return self
+
+    def _on_event(self, event_name: str, message, ctx: dict) -> None:
+        if self._stopped:
+            return
+        topic = ctx.get("topic")
+        kind = self._topics.get(topic)
+        if kind is None or not isinstance(message, dict):
+            return
+        if message.get("origin") == self.store.origin:
+            return  # our own mutation, already applied + synced
+        doc = message.get("payload")
+        if not isinstance(doc, dict):
+            return
+        collection = self.store.collections[kind]
+        try:
+            if event_name.endswith("Created") or event_name.endswith(
+                "Modified"
+            ):
+                if doc.get("id"):
+                    collection.upsert(doc)
+            elif event_name.endswith("Deleted"):
+                if doc.get("collection"):
+                    collection.clear()
+                elif doc.get("id"):
+                    collection.delete(doc["id"])
+            else:
+                return
+        except Exception:  # noqa: BLE001 — a bad frame must not kill the pump
+            if self.logger:
+                self.logger.exception(
+                    "replication apply failed",
+                    extra={"topic": topic, "event": event_name},
+                )
+            return
+        self._applied += 1
+        self._schedule_sync()
+
+    def _schedule_sync(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.debounce_s, self._sync)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _sync(self) -> None:
+        try:
+            self.store.load()
+        except Exception:  # noqa: BLE001
+            if self.logger:
+                self.logger.exception("replication tree sync failed")
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

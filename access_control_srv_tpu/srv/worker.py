@@ -286,11 +286,25 @@ class Worker:
 
         # policy load (reference: src/worker.ts:245)
         self.service.load_policies()
+
+        # multi-worker shared policy state: over a broker bus, the
+        # journaled CRUD topic logs ARE the shared durable policy store
+        # (the reference's shared-Arango role) — replay them at boot and
+        # apply live frames from other workers (srv/store.PolicyReplicator)
+        self.replicator = None
+        if broker_address and cfg.get("replication:enabled", True):
+            from .store import PolicyReplicator
+
+            self.replicator = PolicyReplicator(
+                self.store, self.bus, logger=self.logger
+            ).start()
         return self
 
     def stop(self) -> None:
         if self.batcher is not None:
             self.batcher.stop()
+        if getattr(self, "replicator", None) is not None:
+            self.replicator.stop()
         for attr in ("bus", "offset_store", "subject_cache"):
             backend = getattr(self, attr, None)
             if backend is not None and hasattr(backend, "close"):

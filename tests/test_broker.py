@@ -409,3 +409,151 @@ def test_worker_config_passes_broker_secret(tmp_path):
             )
     finally:
         server.stop()
+
+
+def test_two_workers_share_one_policy_state(broker):
+    """Multi-worker shared mutable policy state (the reference's
+    shared-Arango role, src/resourceManager.ts hot-sync over shared
+    persistence): CRUD on worker A becomes decision-visible on worker B
+    without restart, via the broker's journaled CRUD topic log."""
+    from .utils import URNS as U
+    from access_control_srv_tpu.models import Attribute, Request, Target
+
+    def make():
+        return Worker().start({
+            "policies": {"type": "database"},
+            "seed_data": {
+                "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+                "policies": os.path.join(SEED, "policies.yaml"),
+                "rules": os.path.join(SEED, "rules.yaml"),
+            },
+            "events": {"broker": {"address": broker.address}},
+        })
+
+    def req(role):
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=U["role"], value=role),
+                          Attribute(id=U["subjectID"], value="u1")],
+                resources=[Attribute(id=U["entity"], value=ORG)],
+                actions=[Attribute(id=U["actionID"], value=U["read"])],
+            ),
+            context={"resources": [], "subject": {
+                "id": "u1",
+                "role_associations": [{"role": role, "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        )
+
+    worker_a = make()
+    worker_b = make()
+    try:
+        assert worker_a.replicator is not None
+        assert worker_b.engine.is_allowed(
+            req("replica-role")).decision == "INDETERMINATE"
+
+        # CRUD on A: new rule + attach to the seeded policy
+        rules_a = worker_a.store.get_resource_service("rule")
+        rules_a.create([{
+            "id": "replica-rule", "name": "replica",
+            "effect": "PERMIT",
+            "target": {
+                "subjects": [{"id": U["role"], "value": "replica-role"}],
+                "resources": [{"id": U["entity"], "value": ORG}],
+                "actions": [],
+            },
+        }])
+        policies_a = worker_a.store.get_resource_service("policy")
+        doc = dict(policies_a.read()["items"][0]["payload"])
+        doc["rules"] = list(doc.get("rules") or []) + ["replica-rule"]
+        assert policies_a.update([doc])["operation_status"]["code"] == 200
+
+        # worker B converges without restart (replication debounce +
+        # recompile are async)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if worker_b.engine.is_allowed(
+                req("replica-role")).decision == "PERMIT":
+                break
+            time.sleep(0.1)
+        assert worker_b.engine.is_allowed(
+            req("replica-role")).decision == "PERMIT"
+        # and B's evaluator (kernel path) answers the same
+        out = worker_b.evaluator.is_allowed_batch([req("replica-role")])
+        assert out[0].decision == "PERMIT"
+
+        # delete on A propagates too
+        rules_a.delete(ids=["replica-rule"])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if worker_b.engine.is_allowed(
+                req("replica-role")).decision == "INDETERMINATE":
+                break
+            time.sleep(0.1)
+        assert worker_b.engine.is_allowed(
+            req("replica-role")).decision == "INDETERMINATE"
+    finally:
+        worker_a.stop()
+        worker_b.stop()
+
+
+def test_late_worker_replays_crud_log(broker):
+    """A worker that boots AFTER mutations landed replays the broker's
+    CRUD log to the same state (the durable-shared-store property)."""
+    from .utils import URNS as U
+    from access_control_srv_tpu.models import Attribute, Request, Target
+
+    def req(role):
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=U["role"], value=role),
+                          Attribute(id=U["subjectID"], value="u1")],
+                resources=[Attribute(id=U["entity"], value=ORG)],
+                actions=[Attribute(id=U["actionID"], value=U["read"])],
+            ),
+            context={"resources": [], "subject": {
+                "id": "u1",
+                "role_associations": [{"role": role, "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        )
+
+    cfg = {
+        "policies": {"type": "database"},
+        "seed_data": {
+            "policy_sets": os.path.join(SEED, "policy_sets.yaml"),
+            "policies": os.path.join(SEED, "policies.yaml"),
+            "rules": os.path.join(SEED, "rules.yaml"),
+        },
+        "events": {"broker": {"address": broker.address}},
+    }
+    worker_a = Worker().start(cfg)
+    try:
+        rules_a = worker_a.store.get_resource_service("rule")
+        rules_a.create([{
+            "id": "late-rule", "name": "late", "effect": "PERMIT",
+            "target": {
+                "subjects": [{"id": U["role"], "value": "late-role"}],
+                "resources": [{"id": U["entity"], "value": ORG}],
+                "actions": [],
+            },
+        }])
+        policies_a = worker_a.store.get_resource_service("policy")
+        doc = dict(policies_a.read()["items"][0]["payload"])
+        doc["rules"] = list(doc.get("rules") or []) + ["late-rule"]
+        assert policies_a.update([doc])["operation_status"]["code"] == 200
+
+        worker_b = Worker().start(cfg)  # boots after the mutations
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if worker_b.engine.is_allowed(
+                    req("late-role")).decision == "PERMIT":
+                    break
+                time.sleep(0.1)
+            assert worker_b.engine.is_allowed(
+                req("late-role")).decision == "PERMIT"
+        finally:
+            worker_b.stop()
+    finally:
+        worker_a.stop()
