@@ -9,7 +9,15 @@ tree (+ kernel recompile via the evaluator).
 
 Persistence is pluggable: the default collection is in-memory with an
 optional JSON snapshot directory (the ArangoDB role is durability +
-queries; decision semantics never depended on it, SURVEY.md L6)."""
+queries; decision semantics never depended on it, SURVEY.md L6).
+
+CRUD topic contract: frames on ``io.restorecommerce.{kind}s.resource``
+are ``{"payload": <resource doc | {"id"} | {"collection": true}>,
+"origin": <emitting store id>}`` — the envelope lets PolicyReplicator
+skip a worker's own echoes; consumers wanting the raw resource read
+``message["payload"]``.  (The reference's Kafka frames carry the bare
+resource proto; this bus is framework-internal, the reference-wire
+surface is gRPC — docs/WIRE_COMPAT.md.)"""
 
 from __future__ import annotations
 
@@ -497,6 +505,12 @@ class PolicyStore:
         # replicator can distinguish this worker's own mutations from
         # remote ones (srv/store.PolicyReplicator)
         self.origin = uuid.uuid4().hex
+        # serializes tree recompose+swap: local CRUD sync and the
+        # replicator's debounced sync may run on different threads, and
+        # an unserialized older compose must not swap in after a newer
+        # one (load() reads the collections under this lock, so the last
+        # swap always reflects the latest collection state)
+        self._load_lock = threading.Lock()
 
     def get_resource_service(self, kind: str) -> ResourceService:
         return self.services[kind]
@@ -505,7 +519,12 @@ class PolicyStore:
         """Compose the 3-level tree from the flat collections and swap it
         into the engine (reference: PolicySetService.load).  The new tree is
         built aside and swapped in with one reference assignment so serving
-        threads never observe a cleared or half-built tree."""
+        threads never observe a cleared or half-built tree; the whole
+        read-compose-swap is serialized under _load_lock (see __init__)."""
+        with self._load_lock:
+            self._load_locked()
+
+    def _load_locked(self) -> None:
         rules = {d["id"]: rule_from_dict(d) for d in self.collections["rule"].all()}
         policies = {}
         for p_doc in self.collections["policy"].all():
@@ -550,6 +569,16 @@ class PolicyStore:
         self.services["policy"].super_upsert(policy_docs, sync=False)
         self.services["policy_set"].super_upsert(policy_set_docs, sync=False)
         self.load()
+
+
+# remote-frame validators per resource kind (PolicyReplicator): the same
+# composers store.load() runs, invoked up front so a malformed frame is
+# rejected instead of persisted
+_VALIDATORS = {
+    "rule": rule_from_dict,
+    "policy": policy_from_dict,
+    "policy_set": policy_set_from_dict,
+}
 
 
 class PolicyReplicator:
@@ -614,6 +643,11 @@ class PolicyReplicator:
                 "Modified"
             ):
                 if doc.get("id"):
+                    # quarantine malformed remote docs BEFORE they reach
+                    # the collection: a doc the composers reject would
+                    # otherwise poison every later store.load() on this
+                    # worker (local CRUD included)
+                    _VALIDATORS[kind](doc)
                     collection.upsert(doc)
             elif event_name.endswith("Deleted"):
                 if doc.get("collection"):
@@ -633,16 +667,20 @@ class PolicyReplicator:
         self._schedule_sync()
 
     def _schedule_sync(self) -> None:
+        # arm only when no sync is pending: the pending sync composes
+        # from the live collections at fire time, so it covers every
+        # frame applied before it runs — and a replay burst of N frames
+        # costs one timer thread, not N
         with self._lock:
-            if self._stopped:
+            if self._stopped or self._timer is not None:
                 return
-            if self._timer is not None:
-                self._timer.cancel()
             self._timer = threading.Timer(self.debounce_s, self._sync)
             self._timer.daemon = True
             self._timer.start()
 
     def _sync(self) -> None:
+        with self._lock:
+            self._timer = None
         try:
             self.store.load()
         except Exception:  # noqa: BLE001

@@ -506,6 +506,14 @@ class GrpcServer:
             )
         )
 
+        # reference-wire aliases: the same handlers under the
+        # restorecommerce service names + message shapes, so stock
+        # restorecommerce clients (acs-client et al.) call this service
+        # unmodified (srv/transport_rc.py)
+        from .transport_rc import register_rc_services
+
+        register_rc_services(self.server, worker)
+
     def _crud_handlers(self, kind, doc_from_pb, list_cls, list_resp_cls, fill):
         service = self.worker.store.get_resource_service(kind)
 

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Regenerate the restorecommerce-wire stubs (srv/gen/rc).
+
+protoc emits package-rooted imports (``from io.restorecommerce import
+...``) whose top-level package collides with the stdlib ``io`` module, so
+the generated files are flattened into one package and their imports
+rewritten to relative form.  Run from the repo root:
+
+    python proto/build_rc.py
+"""
+
+import os
+import re
+import subprocess
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "proto", "rc")
+OUT = os.path.join(REPO, "access_control_srv_tpu", "srv", "gen", "rc")
+
+
+def main() -> None:
+    protos = []
+    for root, _, files in os.walk(SRC):
+        for f in files:
+            if f.endswith(".proto"):
+                protos.append(
+                    os.path.relpath(os.path.join(root, f), SRC)
+                )
+    with tempfile.TemporaryDirectory() as tmp:
+        subprocess.run(
+            ["protoc", f"--python_out={tmp}", *sorted(protos)],
+            cwd=SRC, check=True,
+        )
+        os.makedirs(OUT, exist_ok=True)
+        for root, _, files in os.walk(tmp):
+            for f in files:
+                if not f.endswith("_pb2.py"):
+                    continue
+                text = open(os.path.join(root, f), encoding="utf-8").read()
+                text = re.sub(
+                    r"from io\.restorecommerce import (\w+) as",
+                    r"from . import \1 as",
+                    text,
+                )
+                text = re.sub(
+                    r"from grpc\.health\.v1 import (\w+) as",
+                    r"from . import \1 as",
+                    text,
+                )
+                open(os.path.join(OUT, f), "w", encoding="utf-8").write(text)
+    init = os.path.join(OUT, "__init__.py")
+    open(init, "w", encoding="utf-8").write(
+        '"""Generated restorecommerce-wire stubs (see proto/build_rc.py);\n'
+        "the proto sources under proto/rc/ are reconstructions of the\n"
+        'public @restorecommerce/protos package."""\n'
+    )
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
