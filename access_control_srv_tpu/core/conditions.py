@@ -573,11 +573,30 @@ class _ExecutionBudget:
 CONDITION_MAX_EVENTS = 200_000
 
 
+# syntax only JavaScript can be: arrow functions, JS logical/strict
+# operators, declaration keywords.  Python conditions containing these
+# inside STRING literals would misroute — documented limitation of the
+# migration shim (docs/MIGRATING_CONDITIONS.md).
+_JS_MARKERS = re.compile(
+    r"=>|&&|\|\||===|!==|\btypeof\s|\b(?:let|const|var)\s+[A-Za-z_$]"
+)
+
+
 def condition_matches(condition: str, request) -> bool:
     """Evaluate ``condition`` for ``request``; truthy result means the rule's
-    condition holds.  May raise on malformed conditions / contexts."""
+    condition holds.  May raise on malformed conditions / contexts.
+
+    Conditions are written in the sandboxed Python subset below; REFERENCE
+    policies carrying JavaScript conditions (the reference evals raw JS,
+    src/core/utils.ts:47-56) run unmodified through the JS-subset
+    interpreter (core/js_conditions.py) — detected by JS-only syntax
+    markers or by failing to parse as Python."""
 
     condition = condition.replace("\\n", "\n")
+    if _JS_MARKERS.search(condition):
+        from .js_conditions import evaluate_js_condition
+
+        return evaluate_js_condition(condition, request)
     target = request.target
     context = request.context
     # a single namespace (globals) so comprehension/generator scopes inside
@@ -603,8 +622,14 @@ def condition_matches(condition: str, request) -> bool:
         tree = ast.parse(condition, mode="eval")
         is_expression = True
     except SyntaxError:
-        tree = ast.parse(condition, mode="exec")
-        is_expression = False
+        try:
+            tree = ast.parse(condition, mode="exec")
+            is_expression = False
+        except SyntaxError:
+            # not Python at all: the JS migration path
+            from .js_conditions import evaluate_js_condition
+
+            return evaluate_js_condition(condition, request)
     _validate_condition_ast(tree)
     tree = ast.fix_missing_locations(_GuardBinOps().visit(tree))
 

@@ -32,9 +32,17 @@ from ..models.model import (
 def _coerce_context_query(obj: Any) -> Optional[ContextQuery]:
     if not obj:
         return None
-    return ContextQuery(
-        filters=list(obj.get("filters") or []), query=obj.get("query") or ""
-    )
+    # the reference proto nests filter groups (ContextQuery.filters ->
+    # repeated Filters -> repeated Filter; fixture
+    # test/fixtures/context_query.yml); the internal model keeps one
+    # flat predicate list, so nested groups flatten on load
+    filters = []
+    for entry in obj.get("filters") or []:
+        if isinstance(entry, dict) and isinstance(entry.get("filters"), list):
+            filters.extend(entry["filters"])
+        else:
+            filters.append(entry)
+    return ContextQuery(filters=filters, query=obj.get("query") or "")
 
 
 def rule_from_dict(doc: dict) -> Rule:

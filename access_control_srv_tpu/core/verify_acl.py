@@ -81,11 +81,21 @@ def verify_acl_list(
                 else:
                     return False  # missing ACL indicatory entity
 
-    subject = _get(context, "subject") or {}
-    if _get(subject, "token") and not _get(subject, "hierarchical_scopes"):
+    subject = _get(context, "subject")
+    if subject is not None and _get(subject, "token") and not _get(
+        subject, "hierarchical_scopes"
+    ):
         context = access_controller.create_hr_scope(context)
-        subject = _get(context, "subject") or {}
+        subject = _get(context, "subject")
 
+    if subject is None:
+        # quirk-faithful: the reference dereferences
+        # context.subject.role_associations without a guard
+        # (verifyACL.ts:112) — a missing subject THROWS, and the service
+        # envelope turns it into DENY, not a silent rule skip
+        raise InvalidRequestContext(
+            "cannot read role_associations: request context has no subject"
+        )
     role_associations = _get(subject, "role_associations")
     if not role_associations:
         return False  # impossible to evaluate context

@@ -45,7 +45,7 @@ from .gen.rc import policy_set_pb2 as rc_policy_set
 from .gen.rc import resource_base_pb2 as rc_rb
 from .gen.rc import rule_pb2 as rc_rule
 from .gen.rc import status_pb2 as rc_status
-from .transport_grpc import _unary
+from .transport_grpc import _ctx_value_from_pb, _unary
 
 # rc Decision enum: PERMIT=0, DENY=1, INDETERMINATE=2 (Response.Decision)
 _DECISION_TO_RC = {
@@ -89,13 +89,11 @@ def _target_to_rc(target: Target):
     )
 
 
-def _any_from_rc(msg):
-    """google.protobuf.Any carrying JSON bytes — the reference
-    unmarshals context Any values as JSON (reference:
-    accessControlService.ts:103-125)."""
-    if not msg.value:
-        return None
-    return {"type_url": msg.type_url, "value": bytes(msg.value)}
+# google.protobuf.Any carrying JSON bytes — the reference unmarshals
+# context Any values as JSON (accessControlService.ts:103-125); the
+# field shape matches the internal ContextValue so the acstpu converter
+# is shared
+_any_from_rc = _ctx_value_from_pb
 
 
 def request_from_rc(msg) -> Request:
