@@ -84,6 +84,11 @@ class ReverseQueryKernel:
         # rule serves every request this kernel answers — object
         # construction was the wia-large host-assembly bottleneck.  The
         # cache lives exactly as long as the version-pinned snapshot.
+        # ALIASING INVARIANT: one RuleRQ instance appears in MANY
+        # concurrent ReverseQuery responses — consumers must treat it as
+        # immutable (serialize, never annotate in place), and the id()
+        # keys are valid only because self.sets pins the rule objects
+        # alive for this kernel's lifetime.
         self._rule_rq_cache: dict[int, RuleRQ] = {}
         self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
         self._runs: dict[tuple, object] = {}

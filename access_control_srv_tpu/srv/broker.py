@@ -61,11 +61,25 @@ class BrokerServer:
     ``secret`` enables authentication: the first frame of every
     connection must be {"op": "auth", "secret": ...} or the connection is
     refused — the deployed-Kafka/Redis auth the reference inherits from
-    its infrastructure."""
+    its infrastructure.  The secret travels as CLEARTEXT JSON over TCP
+    (as does all topic/KV traffic): the design assumption is loopback or
+    an otherwise-trusted network segment, exactly like an unencrypted
+    Kafka/Redis deployment; binding a non-loopback interface logs a
+    warning and calls for transport-level protection (TLS tunnel,
+    private VPC)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  data_dir: Optional[str] = None,
                  secret: Optional[str] = None):
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            import sys as _sys
+
+            print(
+                f"WARNING: broker binding non-loopback address {host!r}: "
+                "the shared secret and all bus traffic travel as cleartext "
+                "TCP — use a TLS tunnel or a trusted network segment",
+                file=_sys.stderr,
+            )
         self._topics: dict[str, list[tuple[str, Any]]] = {}
         self._kv: dict[str, Any] = {}
         self._consumer_offsets: dict[str, int] = {}

@@ -45,7 +45,13 @@ class Collection:
     rewritten only on bulk loads, clears, or when the journal exceeds
     ``compact_every`` records (then the journal is truncated).  Startup
     loads the snapshot and replays the journal; a torn trailing record
-    (crash mid-append) is skipped."""
+    (crash mid-append) is skipped.
+
+    Durability caveat (same contract as the broker journal): records are
+    flushed per append but NOT fsynced — a process crash loses nothing
+    already flushed; a host-level crash can drop the flushed tail still
+    in the page cache.  Call ``close()`` on shutdown so the handle does
+    not rely on GC."""
 
     def __init__(self, name: str, snapshot_dir: Optional[str] = None,
                  compact_every: int = 1024):
@@ -156,6 +162,13 @@ class Collection:
     def all(self) -> list[dict]:
         with self._lock:
             return [copy.deepcopy(d) for d in self._docs.values()]
+
+    def close(self) -> None:
+        """Close the journal handle; shutdown must not rely on GC."""
+        with self._lock:
+            if self._journal_fh is not None:
+                self._journal_fh.close()
+                self._journal_fh = None
 
     def clear(self) -> None:
         with self._lock:

@@ -306,6 +306,15 @@ def _subject_from_pb(msg: pb.Subject) -> Optional[dict]:
 
 # ----------------------------------------------------------------- server
 
+# batched envelopes exceed gRPC's 4 MB default well before the batcher's
+# max_batch (an 8192-row BatchRequest is ~3.9 MB); 64 MB covers the
+# largest configured batch with headroom
+_MESSAGE_SIZE_OPTIONS = (
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+)
+
+
 def _unary(handler, req_cls, resp_cls):
     return grpc.unary_unary_rpc_method_handler(
         handler,
@@ -320,7 +329,8 @@ class GrpcServer:
     def __init__(self, worker, addr: str = "127.0.0.1:0", max_workers: int = 16):
         self.worker = worker
         self.server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers)
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=_MESSAGE_SIZE_OPTIONS,
         )
         self._register()
         self.port = self.server.add_insecure_port(addr)
@@ -674,7 +684,9 @@ class GrpcClient:
     """Typed client over the generic channel (test + SDK use)."""
 
     def __init__(self, addr: str):
-        self.channel = grpc.insecure_channel(addr)
+        self.channel = grpc.insecure_channel(
+            addr, options=_MESSAGE_SIZE_OPTIONS
+        )
 
     def close(self):
         self.channel.close()

@@ -305,6 +305,9 @@ class Worker:
             self.batcher.stop()
         if getattr(self, "replicator", None) is not None:
             self.replicator.stop()
+        if getattr(self, "store", None) is not None:
+            for collection in self.store.collections.values():
+                collection.close()
         for attr in ("bus", "offset_store", "subject_cache"):
             backend = getattr(self, attr, None)
             if backend is not None and hasattr(backend, "close"):
