@@ -1,0 +1,62 @@
+# Deployment image (the reference ships a two-stage Node build,
+# /root/reference/Dockerfile; this is the TPU-native equivalent).
+#
+# Base: for TPU hosts use a jax[tpu]-enabled base and run with the TPU
+# runtime mounted; the default below is the CPU/self-test image — the
+# framework serves correctly (oracle + CPU-backend kernels) without an
+# accelerator and picks the TPU backend up automatically when libtpu is
+# present.
+
+### Build (compile the native host encoder + generated stubs)
+FROM python:3.12-slim AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ protobuf-compiler && rm -rf /var/lib/apt/lists/*
+
+ARG APP_HOME=/srv/access-control-srv-tpu
+WORKDIR $APP_HOME
+COPY . .
+
+# regenerate the protobuf stubs against the image's protoc — a failure
+# here MUST fail the build (stale stubs would ship a wire surface that
+# no longer matches the .proto).  The native wire encoder compiles
+# itself on first use at runtime (the deployment stage ships g++); a
+# compile failure there degrades to the Python encoder.
+RUN protoc --python_out=access_control_srv_tpu/srv/gen \
+        -I proto proto/access_control.proto
+RUN python proto/build_rc.py
+
+### Deployment
+FROM python:3.12-slim AS deployment
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/* \
+    && pip install --no-cache-dir \
+        "jax>=0.4.30" grpcio protobuf pyyaml numpy
+
+RUN useradd --create-home acs \
+    && mkdir -p /var/lib/acs-tpu && chown acs:acs /var/lib/acs-tpu
+USER acs
+ARG APP_HOME=/srv/access-control-srv-tpu
+WORKDIR $APP_HOME
+
+# the production overlay (cfg/config_production.json: authorization on,
+# durable snapshots under /var/lib/acs-tpu, port 50051) is selected via
+# NODE_ENV, same convention as the reference's service-config
+ENV NODE_ENV=production
+
+COPY --from=build --chown=acs:acs $APP_HOME/access_control_srv_tpu \
+    $APP_HOME/access_control_srv_tpu
+COPY --from=build --chown=acs:acs $APP_HOME/data $APP_HOME/data
+COPY --from=build --chown=acs:acs $APP_HOME/cfg $APP_HOME/cfg
+
+# gRPC serving port (reference: cfg/config_production.json 50051)
+EXPOSE 50051
+
+# the reference's container healthcheck role: grpc.health.v1.Health/Check
+# over the serving port (docs/WIRE_COMPAT.md)
+HEALTHCHECK --interval=30s --timeout=5s --start-period=60s \
+    CMD python -m access_control_srv_tpu.healthcheck 127.0.0.1:50051
+
+CMD ["python", "-m", "access_control_srv_tpu", \
+     "--config-dir", "cfg", "--addr", "0.0.0.0:50051"]

@@ -735,6 +735,14 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
       o.eligible[b] = 0;  // token subjects take the host protocol path
       continue;
     }
+    if (subject.kind == JValue::Null) {
+      // quirk parity with the Python encoder: a subject-less context can
+      // make the reference's unguarded context.subject dereference throw
+      // inside verifyACL (verifyACL.ts:112), which the kernel formula
+      // cannot represent -- all such rows take the oracle path
+      o.eligible[b] = 0;
+      continue;
+    }
 
     // ---- subject / roles / actions
     if ((int)req.subjects.size() > NSUB || (int)req.actions.size() > NACT) {

@@ -404,21 +404,6 @@ def encode_requests(
             batch_entity_values.append(value)
         return idx
 
-    def _acl_early_pass(target, context, rid_urn, op_urn) -> bool:
-        """True when some resourceID/operation attribute's context
-        resource lacks ACL metadata — the reference's per-resource
-        all-clear (verifyACL.ts:37-59) that returns before the unguarded
-        context.subject dereference."""
-        ctx_resources = get_field(context, "resources") or []
-        for attr in (target.resources or []):
-            if attr.id not in (rid_urn, op_urn):
-                continue
-            resource = find_ctx_resource(ctx_resources, attr.value)
-            acls = get_field(get_field(resource, "meta"), "acls")
-            if not acls:
-                return True
-        return False
-
     a = alloc_row_arrays(B, caps)
     eligible = np.ones((B,), bool)
     ineligible_reasons: dict[str, int] = {}
@@ -439,16 +424,17 @@ def encode_requests(
         if get_field(subject, "token"):
             mark(b, "token-subject")
             continue
-        if raw_subject is None and not _acl_early_pass(
-            target, context, resource_id_urn, operation_urn
-        ):
+        if raw_subject is None:
             # quirk parity: a matched rule's ACL check dereferences
             # context.subject without a guard in the reference
-            # (verifyACL.ts:112) unless some resourceID/operation
-            # attribute's context resource LACKS ACL metadata (the
-            # early all-clear, :56-59) — subject-less rows without that
-            # early pass can throw, which the kernel formula cannot
-            # represent; serve them from the oracle
+            # (verifyACL.ts:112) unless a resourceID/operation
+            # attribute's missing ACL metadata triggered the early
+            # all-clear (:56-59) — subject-less rows can therefore throw,
+            # which the kernel formula cannot represent.  ALL subject-less
+            # rows go to the oracle (conservative: some could stay on
+            # device via the early pass, but this is error-path traffic
+            # and the simple rule is mirrored bit-for-bit by the native
+            # C++ encoder)
             mark(b, "no-subject")
             continue
 

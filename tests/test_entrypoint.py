@@ -70,3 +70,54 @@ def test_main_rejects_malformed_addr():
         with pytest.raises(SystemExit) as exc:
             main(["--broker", "--addr", bad])
         assert exc.value.code == 2
+
+
+def test_healthcheck_module_against_live_server(tmp_path):
+    """Container healthcheck (python -m access_control_srv_tpu.healthcheck)
+    round-trips grpc.health.v1.Health/Check against a worker served from
+    the shipped cfg/ directory (Dockerfile HEALTHCHECK contract)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "access_control_srv_tpu",
+         "--config-dir", "cfg", "--addr", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo,
+    )
+    try:
+        import queue
+        import threading
+        import time
+
+        lines: "queue.Queue[str]" = queue.Queue()
+
+        def pump():
+            for ln in proc.stdout:
+                lines.put(ln)
+
+        threading.Thread(target=pump, daemon=True).start()
+        addr = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                line = lines.get(timeout=1)
+            except queue.Empty:
+                continue
+            if "serving on" in line:
+                addr = line.strip().rsplit(" ", 1)[-1]
+                break
+        assert addr, "server never announced its address"
+        rc = subprocess.run(
+            [sys.executable, "-m", "access_control_srv_tpu.healthcheck",
+             addr],
+            capture_output=True, text=True, env=env, cwd=repo, timeout=60,
+        )
+        assert rc.returncode == 0, rc.stderr
+        assert "SERVING" in rc.stdout
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
