@@ -71,20 +71,65 @@ class MaskingFilter(logging.Filter):
                 mask_secrets(a) if isinstance(a, (dict, list)) else a
                 for a in record.args
             )
+        lowered = tuple(f.lower() for f in MASK_FIELDS)
         for key, value in list(record.__dict__.items()):
             if key in _STANDARD_RECORD_FIELDS:
                 continue
             if isinstance(value, (dict, list)):
                 setattr(record, key, mask_secrets(value))
+            elif any(f in key.lower() for f in lowered):
+                # scalar extra under a secret-named key
+                setattr(record, key, _MASK)
         return True
 
 
+class JsonLinesFormatter(logging.Formatter):
+    """One JSON object per record: timestamp, level, logger, message and
+    every ``extra`` field (secrets already masked by MaskingFilter).  The
+    shape log shippers (filebeat/fluent-bit/vector) ingest directly —
+    the production log-shipping role the reference fills with a winston
+    Elasticsearch transport (cfg/config_production.json:3-10); shipping
+    is the collector's job, the service just emits structured lines."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+
+        out = {
+            "@timestamp": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for key, value in record.__dict__.items():
+            if key in _STANDARD_RECORD_FIELDS or key in out:
+                continue
+            out[key] = value
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        # default=repr: one serialization pass; non-JSON values degrade
+        # to their repr instead of dropping the record
+        return json.dumps(out, default=repr)
+
+
 def make_logger(name: str = "access-control-srv-tpu",
-                level: int = logging.INFO) -> logging.Logger:
+                level: int = logging.INFO,
+                json_sink: Optional[str] = None) -> logging.Logger:
+    """``json_sink``: optional path; when set, masked records also append
+    as JSON lines for an external shipper to tail (config key
+    ``logging:json_sink`` — srv/worker.py)."""
     logger = logging.getLogger(name)
     logger.setLevel(level)
     if not any(isinstance(f, MaskingFilter) for f in logger.filters):
         logger.addFilter(MaskingFilter())
+    if json_sink and not any(
+        isinstance(h, logging.FileHandler)
+        and getattr(h, "_acs_json_sink", None) == json_sink
+        for h in logger.handlers
+    ):
+        handler = logging.FileHandler(json_sink)
+        handler.setFormatter(JsonLinesFormatter())
+        handler._acs_json_sink = json_sink
+        logger.addHandler(handler)
     return logger
 
 

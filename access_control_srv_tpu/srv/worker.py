@@ -64,7 +64,15 @@ class Worker:
     ) -> "Worker":
         self.cfg = cfg if isinstance(cfg, Config) else Config(cfg or {})
         cfg = self.cfg
-        self.logger = logger or make_logger()
+        json_sink = cfg.get("logging:json_sink")
+        self.logger = logger or make_logger(json_sink=json_sink)
+        # handlers this worker installed on the (process-global) logger,
+        # so stop() can close them — leaking them would keep the fd open
+        # and cross-write records into a stopped worker's sink
+        self._log_handlers = [
+            h for h in self.logger.handlers
+            if getattr(h, "_acs_json_sink", None) == json_sink
+        ] if json_sink else []
         self.telemetry = Telemetry()
 
         # XLA dump hook (SURVEY section 5): best-effort — the flag is read
@@ -308,6 +316,10 @@ class Worker:
         if getattr(self, "store", None) is not None:
             for collection in self.store.collections.values():
                 collection.close()
+        for handler in getattr(self, "_log_handlers", []):
+            handler.close()
+            if self.logger is not None:
+                self.logger.removeHandler(handler)
         for attr in ("bus", "offset_store", "subject_cache"):
             backend = getattr(self, attr, None)
             if backend is not None and hasattr(backend, "close"):

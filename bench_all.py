@@ -248,7 +248,7 @@ def bench_what_is_allowed():
         t0 = time.perf_counter()
         evaluator.what_is_allowed_batch(timed)
         evaluator_qps = max(evaluator_qps, n / (time.perf_counter() - t0))
-    assert telemetry.paths.get("oracle-wia") >= n, (
+    assert telemetry.paths.get("oracle-wia", 0) >= n, (
         "adaptive wia dispatch must serve small trees from the scalar walk"
     )
     return _result(
@@ -1023,6 +1023,28 @@ ACCEL_OK = True  # cleared by main() when the backend probe fails
 
 
 def main():
+    which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
+                             "hr-deep", "stress", "stress-hr", "serve",
+                             "serve-latency", "adapter-mixed"]
+    if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
+        # each config in its own process: in-process accumulation across
+        # the matrix (JAX allocator state, caches, CPU heat) depresses
+        # later rows by up to 2x (measured round 5); every subprocess
+        # probes and merges its own rows into BENCH_ALL.json, so the
+        # parent neither probes nor merges
+        import subprocess
+
+        env = dict(os.environ, BENCH_ISOLATE="0")
+        env.setdefault("BENCH_PROBE_RETRIES", "3")
+        rc_all = 0
+        for name in which:
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), name], env=env
+            ).returncode
+            rc_all = rc_all or rc
+            time.sleep(2)  # let the previous child's TPU teardown settle
+        sys.exit(rc_all)
+
     # BENCH_PLATFORM=cpu forces the CPU backend (the machine pins
     # JAX_PLATFORMS=axon externally, so the env var alone cannot override
     # it — jax.config must be set before the first backend touch)
@@ -1058,26 +1080,6 @@ def main():
                 "device0": info.get("device0"),
             }
 
-    which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
-                             "hr-deep", "stress", "stress-hr", "serve",
-                             "serve-latency", "adapter-mixed"]
-    if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
-        # each config in its own process: in-process accumulation across
-        # the matrix (JAX allocator state, caches, CPU heat) depresses
-        # later rows by up to 2x (measured round 5); every subprocess
-        # merges its own row into BENCH_ALL.json
-        import subprocess
-
-        env = dict(os.environ, BENCH_ISOLATE="0")
-        env.setdefault("BENCH_PROBE_RETRIES", "3")
-        rc_all = 0
-        for name in which:
-            rc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), name], env=env
-            ).returncode
-            rc_all = rc_all or rc
-            time.sleep(2)  # let the previous child's TPU teardown settle
-        sys.exit(rc_all)
     if backend is None:
         global ACCEL_OK
         ACCEL_OK = False

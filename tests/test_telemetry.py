@@ -161,3 +161,64 @@ def test_native_wire_path_records_metrics():
         client.close()
         server.stop()
         w.stop()
+
+
+def test_json_sink_ships_masked_structured_lines(tmp_path):
+    """logging:json_sink appends one JSON object per record with extra
+    fields included and secrets masked — the shape external shippers
+    tail (the reference's production Elasticsearch-transport role)."""
+    import json
+    import logging
+
+    from access_control_srv_tpu.srv.telemetry import make_logger
+
+    sink = tmp_path / "acs.log.jsonl"
+    logger = make_logger("test-json-sink", json_sink=str(sink))
+    try:
+        logger.info("policy loaded", extra={
+            "policy_sets": 3,
+            "subject": {"id": "u1", "token": "supersecret"},
+        })
+        logger.warning("auth failed", extra={"password": "hunter2"})
+    finally:
+        for h in list(logger.handlers):
+            h.close()
+            logger.removeHandler(h)
+    lines = [json.loads(ln) for ln in sink.read_text().splitlines()]
+    assert lines[0]["message"] == "policy loaded"
+    assert lines[0]["policy_sets"] == 3
+    assert lines[0]["subject"]["token"] == "***"
+    assert lines[1]["level"] == "WARNING"
+    assert lines[1]["password"] == "***"
+    assert all("@timestamp" in ln for ln in lines)
+
+
+def test_worker_config_wires_json_sink(tmp_path):
+    import json
+    import os
+
+    from access_control_srv_tpu.srv import Worker
+
+    seed = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "data", "seed_data",
+    )
+    sink = tmp_path / "worker.jsonl"
+    worker = Worker().start({
+        "logging": {"json_sink": str(sink)},
+        "policies": {"type": "database"},
+        "seed_data": {
+            "policy_sets": os.path.join(seed, "policy_sets.yaml"),
+            "policies": os.path.join(seed, "policies.yaml"),
+            "rules": os.path.join(seed, "rules.yaml"),
+        },
+    })
+    try:
+        worker.logger.info("sink probe", extra={"probe": True})
+    finally:
+        worker.stop()
+        for h in list(worker.logger.handlers):
+            h.close()
+            worker.logger.removeHandler(h)
+    lines = [json.loads(ln) for ln in sink.read_text().splitlines()]
+    assert any(ln.get("probe") for ln in lines)
