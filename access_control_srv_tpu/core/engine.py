@@ -160,8 +160,17 @@ class AccessController:
             return self.hr_scope_provider.create_hr_scope(context)
         return context
 
-    def is_allowed(self, request: Request) -> Response:
-        """Evaluate an access request (reference: accessController.ts:88-324)."""
+    def is_allowed(self, request: Request,
+                   candidate_rules=None) -> Response:
+        """Evaluate an access request (reference: accessController.ts:88-324).
+
+        ``candidate_rules``: optional set of rule object ids (from
+        core.candidate_index.CandidateIndex) — targeted rules outside it
+        provably cannot target-match and are skipped without evaluation.
+        Skipping happens AFTER the per-rule evaluation_cacheable
+        aggregation (the reference clears the policy-level cacheable flag
+        for every non-cacheable rule, matched or not — :207-210), so
+        filtered decisions are bit-identical to the full walk."""
         if not request.target:
             return Response(
                 decision=Decision.DENY,
@@ -262,6 +271,12 @@ class AccessController:
                                 evaluation_cacheable = rule.evaluation_cacheable
                                 if not evaluation_cacheable:
                                     evaluation_cacheable_rule = False
+                                if (
+                                    candidate_rules is not None
+                                    and rule.target is not None
+                                    and id(rule) not in candidate_rules
+                                ):
+                                    continue  # provably cannot target-match
 
                                 matches = not rule.target or self._target_matches(
                                     rule.target,

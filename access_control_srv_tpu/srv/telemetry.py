@@ -27,6 +27,7 @@ _BUCKETS = [
 ]
 
 MASK_FIELDS = ("password", "token", "apiKey", "api_key", "authorization")
+_LOWERED_MASK_FIELDS = tuple(f.lower() for f in MASK_FIELDS)
 _MASK = "***"
 
 
@@ -71,13 +72,12 @@ class MaskingFilter(logging.Filter):
                 mask_secrets(a) if isinstance(a, (dict, list)) else a
                 for a in record.args
             )
-        lowered = tuple(f.lower() for f in MASK_FIELDS)
         for key, value in list(record.__dict__.items()):
             if key in _STANDARD_RECORD_FIELDS:
                 continue
             if isinstance(value, (dict, list)):
                 setattr(record, key, mask_secrets(value))
-            elif any(f in key.lower() for f in lowered):
+            elif any(f in key.lower() for f in _LOWERED_MASK_FIELDS):
                 # scalar extra under a secret-named key
                 setattr(record, key, _MASK)
         return True

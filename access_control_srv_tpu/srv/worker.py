@@ -65,14 +65,25 @@ class Worker:
         self.cfg = cfg if isinstance(cfg, Config) else Config(cfg or {})
         cfg = self.cfg
         json_sink = cfg.get("logging:json_sink")
-        self.logger = logger or make_logger(json_sink=json_sink)
-        # handlers this worker installed on the (process-global) logger,
-        # so stop() can close them — leaking them would keep the fd open
-        # and cross-write records into a stopped worker's sink
-        self._log_handlers = [
-            h for h in self.logger.handlers
-            if getattr(h, "_acs_json_sink", None) == json_sink
-        ] if json_sink else []
+        if logger is None:
+            import logging as _logging
+
+            pre = set(
+                id(h) for h in
+                _logging.getLogger("access-control-srv-tpu").handlers
+            )
+            self.logger = make_logger(json_sink=json_sink)
+            # close on stop ONLY the handlers THIS start call installed:
+            # a second worker sharing the sink keeps logging through the
+            # handler it found already attached
+            self._log_handlers = [
+                h for h in self.logger.handlers
+                if id(h) not in pre
+                and getattr(h, "_acs_json_sink", None) == json_sink
+            ]
+        else:
+            self.logger = logger
+            self._log_handlers = []
         self.telemetry = Telemetry()
 
         # XLA dump hook (SURVEY section 5): best-effort — the flag is read

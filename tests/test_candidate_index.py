@@ -1,0 +1,181 @@
+"""Candidate-filtered oracle walk: bit-identical to the full walk.
+
+The index (core/candidate_index.py) lets fallback-served requests skip
+rules that provably cannot target-match — the same normative reasoning
+as the kernel's candidate pre-filter.  These differentials drive
+randomized trees (exact entities, regex entities incl. literal-value
+substring aliasing, operations, property-only targets, no-target rules,
+mixed cacheable flags) through both walks and require identical
+responses, including evaluation_cacheable (the reference clears the
+policy-level cacheable flag for every non-cacheable rule, matched or
+not — the skip happens after that aggregation).
+"""
+
+import numpy as np
+import pytest
+
+from access_control_srv_tpu.core import AccessController
+from access_control_srv_tpu.core.candidate_index import CandidateIndex
+from access_control_srv_tpu.core.loader import load_policy_sets
+from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+URNS = Urns()
+DO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:deny-overrides"
+PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+FA = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:first-applicable"
+
+
+def build_engine(seed):
+    rng = np.random.default_rng(seed)
+    ents = [f"urn:restorecommerce:acs:model:v{k}.V{k}" for k in range(9)]
+    policies = []
+    rid = 0
+    for p in range(30):
+        rules = []
+        for q in range(int(rng.integers(1, 25))):
+            kind = int(rng.integers(10))
+            resources = []
+            if kind < 6:  # exact entity
+                resources = [{"id": URNS["entity"], "value": ents[rid % 9]}]
+            elif kind == 6:  # regex-ish entity (literal substring quirk)
+                resources = [{"id": URNS["entity"],
+                              "value": "urn:restorecommerce:acs:model:V[0-4]"}]
+            elif kind == 7:  # operation target
+                resources = [{"id": URNS["operation"], "value": f"op-{rid % 5}"}]
+            elif kind == 8:  # property-only resources
+                resources = [{"id": URNS["property"],
+                              "value": ents[rid % 9] + "#f"}]
+            # kind == 9: no resources at all
+            target = {
+                "resources": resources,
+                "actions": (
+                    [{"id": URNS["actionID"],
+                      "value": [URNS["read"], URNS["modify"]][rid % 2]}]
+                    if rng.integers(3) else []
+                ),
+            }
+            if rng.integers(2):
+                target["subjects"] = [
+                    {"id": URNS["role"], "value": f"role-{rid % 6}"}
+                ]
+            rules.append({
+                "id": f"r{rid}",
+                "target": target if (resources or target["actions"]
+                                     or target.get("subjects")) else None,
+                "effect": ["PERMIT", "DENY"][int(rng.integers(2))],
+                "evaluation_cacheable": bool(rng.integers(2)),
+            })
+            rid += 1
+        policies.append({
+            "id": f"p{p}",
+            "combining_algorithm": [DO, PO, FA][p % 3],
+            "rules": rules,
+        })
+    doc = {"policy_sets": [
+        {"id": "s", "combining_algorithm": DO, "policies": policies}
+    ]}
+    engine = AccessController()
+    for ps in load_policy_sets(doc):
+        engine.update_policy_set(ps)
+    return engine
+
+
+def make_request(rng, ents):
+    role = f"role-{int(rng.integers(8))}"
+    resources = []
+    if rng.integers(4):
+        resources.append(Attribute(id=URNS["entity"],
+                                   value=ents[int(rng.integers(9))]))
+        resources.append(Attribute(id=URNS["resourceID"], value="res-1"))
+    if not rng.integers(3):
+        resources.append(Attribute(id=URNS["operation"],
+                                   value=f"op-{int(rng.integers(6))}"))
+    return Request(
+        target=Target(
+            subjects=[Attribute(id=URNS["role"], value=role),
+                      Attribute(id=URNS["subjectID"], value="u1")],
+            resources=resources,
+            actions=[Attribute(
+                id=URNS["actionID"],
+                value=[URNS["read"], URNS["modify"],
+                       URNS["create"]][int(rng.integers(3))])],
+        ),
+        context={"resources": [], "subject": {
+            "id": "u1",
+            "role_associations": [{"role": role, "attributes": []}],
+            "hierarchical_scopes": [],
+        }},
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_filtered_walk_bit_identical(seed):
+    engine = build_engine(seed)
+    index = CandidateIndex(engine.policy_sets, engine.urns)
+    ents = [f"urn:restorecommerce:acs:model:v{k}.V{k}" for k in range(9)]
+    rng = np.random.default_rng(seed + 100)
+    skipped_total = 0
+    for _ in range(200):
+        request = make_request(rng, ents)
+        cands = index.candidates(request, engine.urns)
+        full = engine.is_allowed(request)
+        filtered = engine.is_allowed(request, candidate_rules=cands)
+        assert filtered.decision == full.decision
+        assert filtered.evaluation_cacheable == full.evaluation_cacheable
+        assert filtered.operation_status.code == full.operation_status.code
+        skipped_total += index.n_rules - len(cands)
+    assert skipped_total > 0, "index never skipped anything"
+
+
+def test_evaluator_uses_index_and_survives_hot_mutation():
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    engine = build_engine(7)
+    evaluator = HybridEvaluator(engine)
+    assert evaluator._cand is not None
+    ents = [f"urn:restorecommerce:acs:model:v{k}.V{k}" for k in range(9)]
+    rng = np.random.default_rng(11)
+    request = make_request(rng, ents)
+    expected = engine.is_allowed(request)
+    assert evaluator.is_allowed(request).decision == expected.decision
+
+    # a tree swap invalidates the index instantly (identity guard) and
+    # refresh() rebuilds it
+    import copy
+
+    new_tree = copy.deepcopy(engine.policy_sets)
+    engine.replace_policy_sets(new_tree)
+    assert evaluator._cand[0] is not engine.policy_sets
+    r1 = evaluator.is_allowed(request)  # unfiltered during the window
+    assert r1.decision == expected.decision
+    evaluator.refresh(wait=True)
+    assert evaluator._cand[0] is engine.policy_sets
+    assert evaluator.is_allowed(request).decision == expected.decision
+
+
+def test_oracle_backend_builds_the_index():
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    engine = build_engine(9)
+    evaluator = HybridEvaluator(engine, backend="oracle")
+    assert evaluator._cand is not None
+    ents = [f"urn:restorecommerce:acs:model:v{k}.V{k}" for k in range(9)]
+    rng = np.random.default_rng(13)
+    for _ in range(20):
+        request = make_request(rng, ents)
+        assert (evaluator.is_allowed(request).decision
+                == engine.is_allowed(request).decision)
+
+
+def test_small_trees_skip_the_index():
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.core import populate
+    import os
+
+    engine = AccessController()
+    populate(engine, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "basic_policies.yml",
+    ))
+    evaluator = HybridEvaluator(engine)
+    assert evaluator._cand is None
