@@ -275,3 +275,50 @@ def test_read_pagination_and_sort(rig):
         dreq.ids.extend(f"rc-page-{i}" for i in range(5))
         _call(channel, "/io.restorecommerce.rule.RuleService/Delete",
               dreq, rc_rb.DeleteResponse)
+
+
+def test_policy_set_crud_under_reference_names(rig):
+    from access_control_srv_tpu.srv.gen.rc import policy_set_pb2 as rc_ps
+
+    worker, channel = rig
+    ps_list = rc_ps.PolicySetList()
+    ps = ps_list.items.add()
+    ps.id = "rc-ps"
+    ps.name = "rc-ps"
+    ps.combining_algorithm = (
+        "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:"
+        "deny-overrides"
+    )
+    resp = _call(channel,
+                 "/io.restorecommerce.policy_set.PolicySetService/Create",
+                 ps_list, rc_ps.PolicySetListResponse)
+    assert resp.operation_status.code == 200
+    try:
+        req = rc_rb.ReadRequest()
+        group = req.filters.add()
+        group.filters.add(field="id",
+                          operation=rc_rb.Filter.Operation.Value("eq"),
+                          value="rc-ps")
+        read = _call(channel,
+                     "/io.restorecommerce.policy_set.PolicySetService/Read",
+                     req, rc_ps.PolicySetListResponse)
+        assert [i.payload.id for i in read.items] == ["rc-ps"]
+        assert read.items[0].payload.combining_algorithm.endswith(
+            "deny-overrides")
+        # upsert mutates in place
+        ps.name = "rc-ps-renamed"
+        upd = rc_ps.PolicySetList()
+        upd.items.add().CopyFrom(ps)
+        resp = _call(
+            channel,
+            "/io.restorecommerce.policy_set.PolicySetService/Upsert",
+            upd, rc_ps.PolicySetListResponse)
+        assert resp.items[0].payload.name == "rc-ps-renamed"
+    finally:
+        dreq = rc_rb.DeleteRequest()
+        dreq.ids.append("rc-ps")
+        dresp = _call(
+            channel,
+            "/io.restorecommerce.policy_set.PolicySetService/Delete",
+            dreq, rc_rb.DeleteResponse)
+        assert dresp.operation_status.code == 200
