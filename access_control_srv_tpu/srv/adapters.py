@@ -7,23 +7,107 @@ grafted onto the request context under ``_queryResult``.
 
 The GraphQL implementation resolves filter property references against the
 request's context resources (reference: gql.ts:30-55), POSTs the query and
-unwraps the ``details`` payloads (reference: gql.ts:66-89).  The HTTP layer
-is injectable (tests pass a transport callable; production uses stdlib
-urllib).
+unwraps the ``details`` payloads (reference: gql.ts:66-89).
+
+Transport: the HTTP layer is injectable (tests pass a transport callable);
+production uses a small keep-alive connection pool over stdlib
+``http.client`` with a configurable per-request timeout (default 5 s —
+the old per-row ``urllib.urlopen`` opened a fresh TCP connection per
+query and hung for 30 s on a slow endpoint, stalling whole oracle-fallback
+batches).  ``query_many`` fans a batch of context queries out over a
+bounded thread pool so N adapter-backed rows stall for ~one timeout, not
+N sequential ones (the evaluator drives its concurrent fallback through
+the same ``max_concurrency`` bound, srv/evaluator.py).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
+import urllib.parse
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 from ..core.common import get_field as _get
 from ..core.errors import UnexpectedContextQueryResponse, UnsupportedResourceAdapter
 
+DEFAULT_TIMEOUT_S = 5.0
+DEFAULT_MAX_CONCURRENCY = 8
+
 
 class ResourceAdapter:
     def query(self, context_query, request) -> Any:
         raise NotImplementedError
+
+
+class _ConnectionPool:
+    """Keep-alive ``http.client`` connections for one endpoint.  Idle
+    connections are reused LIFO; a connection that went stale mid-reuse is
+    discarded and the request retried once on a fresh one."""
+
+    def __init__(self, url: str, timeout_s: float, max_idle: int = 8):
+        parsed = urllib.parse.urlsplit(url)
+        self.scheme = parsed.scheme or "http"
+        self.host = parsed.hostname or ""
+        self.port = parsed.port
+        self.path = parsed.path or "/"
+        if parsed.query:
+            self.path += f"?{parsed.query}"
+        self.timeout_s = timeout_s
+        self.max_idle = max_idle
+        self._idle: list[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+
+    def _connect(self) -> http.client.HTTPConnection:
+        cls = (
+            http.client.HTTPSConnection
+            if self.scheme == "https"
+            else http.client.HTTPConnection
+        )
+        return cls(self.host, self.port, timeout=self.timeout_s)
+
+    def _checkout(self) -> tuple[http.client.HTTPConnection, bool]:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop(), True
+        return self._connect(), False
+
+    def _checkin(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._idle) < self.max_idle:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def post(self, body: bytes, headers: dict) -> bytes:
+        conn, reused = self._checkout()
+        try:
+            conn.request("POST", self.path, body=body, headers=headers)
+            response = conn.getresponse()
+            data = response.read()
+        except Exception:
+            conn.close()
+            if not reused:
+                raise
+            # the pooled connection was closed server-side between uses;
+            # one retry on a fresh connection
+            conn = self._connect()
+            try:
+                conn.request("POST", self.path, body=body, headers=headers)
+                response = conn.getresponse()
+                data = response.read()
+            except Exception:
+                conn.close()
+                raise
+        self._checkin(conn)
+        return data
+
+    def close(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
 
 
 class GraphQLAdapter(ResourceAdapter):
@@ -33,18 +117,39 @@ class GraphQLAdapter(ResourceAdapter):
         logger=None,
         client_opts: dict | None = None,
         transport: Optional[Callable[[str, bytes, dict], bytes]] = None,
+        timeout_s: float | None = None,
+        max_concurrency: int | None = None,
     ):
         self.url = url
         self.logger = logger
         self.client_opts = client_opts or {}
+        self.timeout_s = float(
+            timeout_s
+            if timeout_s is not None
+            else self.client_opts.get("timeout_s", DEFAULT_TIMEOUT_S)
+        )
+        self.max_concurrency = int(
+            max_concurrency
+            if max_concurrency is not None
+            else self.client_opts.get("max_concurrency",
+                                      DEFAULT_MAX_CONCURRENCY)
+        )
+        self._pool: Optional[_ConnectionPool] = None
+        self._pool_lock = threading.Lock()
         self.transport = transport or self._http_post
 
     def _http_post(self, url: str, body: bytes, headers: dict) -> bytes:
-        import urllib.request
+        with self._pool_lock:
+            if self._pool is None or self._pool.timeout_s != self.timeout_s:
+                self._pool = _ConnectionPool(url, self.timeout_s)
+            pool = self._pool
+        return pool.post(body, headers)
 
-        req = urllib.request.Request(url, data=body, headers=headers)
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            return resp.read()
+    def close(self) -> None:
+        with self._pool_lock:
+            if self._pool is not None:
+                self._pool.close()
+                self._pool = None
 
     def _resolve_filters(self, context_query, request) -> dict:
         """Filter values referencing request resource properties are
@@ -101,6 +206,30 @@ class GraphQLAdapter(ResourceAdapter):
             out.append(payload_item if payload_item is not None else item)
         return out
 
+    def query_many(self, pairs: list[tuple[Any, Any]]) -> list[Any]:
+        """Concurrent batch fetch: one ``(context_query, request)`` pair per
+        row, answered in order.  Per-row failures come back as the raised
+        exception object (callers keep the reference's per-row
+        deny-on-error semantics instead of failing the whole batch)."""
+        if not pairs:
+            return []
+        if len(pairs) == 1:
+            cq, request = pairs[0]
+            try:
+                return [self.query(cq, request)]
+            except Exception as err:  # noqa: BLE001 — returned, not raised
+                return [err]
+
+        def one(pair):
+            try:
+                return self.query(pair[0], pair[1])
+            except Exception as err:  # noqa: BLE001
+                return err
+
+        workers = max(1, min(self.max_concurrency, len(pairs)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(one, pairs))
+
 
 def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
     """(reference: accessController.ts:943-951)"""
@@ -109,5 +238,9 @@ def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
         return GraphQLAdapter(
             opts.get("url", ""), logger, opts.get("clientOpts"),
             transport=opts.get("transport"),
+            timeout_s=adapter_config.get("timeout_s", opts.get("timeout_s")),
+            max_concurrency=adapter_config.get(
+                "max_concurrency", opts.get("max_concurrency")
+            ),
         )
     raise UnsupportedResourceAdapter(adapter_config)

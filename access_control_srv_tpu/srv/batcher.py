@@ -49,6 +49,22 @@ class MicroBatcher:
 
     def submit(self, request: Request) -> "Future[Response]":
         future: "Future[Response]" = Future()
+        # decision-cache fast path: a warm cacheable request resolves
+        # immediately instead of waiting out the collection window (and
+        # never occupies a batch slot).  The caller thread already ran
+        # prepare_context (srv/service.py), so the fingerprint is stable.
+        cache = getattr(self.evaluator, "decision_cache", None)
+        if cache is not None and cache.enabled:
+            engine = getattr(self.evaluator, "engine", None)
+            urns = getattr(engine, "urns", None)
+            subject_urn = (urns.get("subjectID") if urns else "") or ""
+            hit = cache.get(cache.fingerprint(request, subject_urn))
+            if hit is not None:
+                count = getattr(self.evaluator, "_count_path", None)
+                if count is not None:
+                    count("cache-hit", 1)
+                future.set_result(hit)
+                return future
         self._queue.put((request, future))
         return future
 

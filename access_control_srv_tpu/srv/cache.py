@@ -53,21 +53,31 @@ class SubjectCache:
 class HRScopeProvider:
     """createHRScope: cache lookup, else request/response rendezvous over
     the auth topic with a parked waiter + timeout
-    (reference: accessController.ts:735-783)."""
+    (reference: accessController.ts:735-783).
+
+    Rendezvous mechanics: all waiters park on ONE shared condition variable
+    and wake together when their token lands in the released set — one
+    kernel wait object total instead of one ``threading.Event`` allocated
+    per in-flight request.  The default timeout is 15 s (config
+    ``authorization:hrReqTimeout``): the reference's 300 s default parks a
+    serving thread for five minutes on a dead auth service."""
 
     def __init__(
         self,
         cache: SubjectCache,
         auth_topic=None,
-        timeout_ms: int = 300_000,
+        timeout_ms: int = 15_000,
         logger=None,
     ):
         self.cache = cache
         self.auth_topic = auth_topic
         self.timeout_ms = timeout_ms
         self.logger = logger
-        self.waiting: dict[str, list[threading.Event]] = {}
-        self._lock = threading.Lock()
+        # token_date -> number of parked waiters; released token_dates are
+        # marked until their last waiter drains
+        self.waiting: dict[str, int] = {}
+        self._released: set[str] = set()
+        self._cond = threading.Condition()
 
     def hr_scopes_key(self, context) -> Optional[str]:
         subject = _get(context, "subject") or {}
@@ -97,23 +107,29 @@ class HRScopeProvider:
                 return context
             date = datetime.datetime.now(datetime.timezone.utc).isoformat()
             token_date = f"{token}:{date}"
-            event = threading.Event()
-            with self._lock:
-                self.waiting.setdefault(token_date, []).append(event)
+            with self._cond:
+                self.waiting[token_date] = self.waiting.get(token_date, 0) + 1
+            # emit OUTSIDE the condition: loopback responders may answer
+            # synchronously on this very thread (tests do), and the
+            # response handler takes the condition to release
             self.auth_topic.emit(
                 "hierarchicalScopesRequest", {"token": token_date}
             )
-            released = event.wait(self.timeout_ms / 1000.0)
+            with self._cond:
+                released = self._cond.wait_for(
+                    lambda: token_date in self._released,
+                    timeout=self.timeout_ms / 1000.0,
+                )
+                # un-park: the last waiter out clears the bookkeeping so
+                # neither the waiting map nor the released set leaks
+                # (token_date keys are unique per call)
+                remaining = self.waiting.get(token_date, 1) - 1
+                if remaining <= 0:
+                    self.waiting.pop(token_date, None)
+                    self._released.discard(token_date)
+                else:
+                    self.waiting[token_date] = remaining
             if not released:
-                # un-park on timeout or the waiting map leaks one entry per
-                # request (token_date keys are unique per call)
-                with self._lock:
-                    events = self.waiting.get(token_date)
-                    if events is not None:
-                        if event in events:
-                            events.remove(event)
-                        if not events:
-                            del self.waiting[token_date]
                 if self.logger:
                     self.logger.error(
                         "hr scope read timed out", extra={"token": token_date}
@@ -148,10 +164,10 @@ class HRScopeProvider:
             else:
                 key = f"cache:{subject_id}:{token}:hrScopes"
             self.cache.set(key, scopes)
-        with self._lock:
-            events = self.waiting.pop(token_date, [])
-        for event in events:
-            event.set()
+        with self._cond:
+            if token_date in self.waiting:
+                self._released.add(token_date)
+                self._cond.notify_all()
 
     def evict_hr_scopes(self, subject_id: str) -> int:
         """(reference: accessController.ts:717-725)"""

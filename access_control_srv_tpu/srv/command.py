@@ -16,11 +16,13 @@ from .. import __version__
 
 
 class CommandInterface:
-    def __init__(self, cfg, service, store=None, bus=None, cache=None, logger=None):
+    def __init__(self, cfg, service, store=None, bus=None, cache=None,
+                 decision_cache=None, logger=None):
         self.cfg = cfg
         self.service = service
         self.store = store
         self.cache = cache
+        self.decision_cache = decision_cache
         self.logger = logger
         self.api_key: Optional[str] = None
         self.start_time = time.time()
@@ -99,6 +101,13 @@ class CommandInterface:
             evaluator = self.service.evaluator
             if evaluator is not None:
                 detail["kernel_active"] = evaluator.kernel_active
+            decision_cache = self.decision_cache
+            if decision_cache is None and evaluator is not None:
+                decision_cache = getattr(evaluator, "decision_cache", None)
+            if decision_cache is not None:
+                # hit/miss/eviction counters + hit ratio on the health
+                # surface (the operator-facing cache-efficacy signal)
+                detail["decision_cache"] = decision_cache.stats()
         except Exception as err:  # pragma: no cover
             healthy = False
             detail["error"] = str(err)
@@ -111,16 +120,37 @@ class CommandInterface:
     def config_update(self, payload: dict) -> dict:
         for path, value in (payload or {}).items():
             self.cfg.set(path, value)
+        if self.decision_cache is not None and payload:
+            # config can change decision semantics (authorization toggles,
+            # adapter endpoints): logically flush cached decisions
+            self.decision_cache.bump_epoch()
         return {"status": "updated", "keys": list((payload or {}).keys())}
 
     def flush_cache(self, payload: dict) -> dict:
-        """(reference: chassis flush_cache + utils.ts flushACSCache)"""
+        """Reference flush_cache payload semantics: ``{"data": {"db_index":
+        N, "pattern": P}}`` — db_index selects which store flushes (the
+        subject cache's Redis-DB-4 analog vs the decision cache's DB-5
+        analog, cfg ``redis:db-indexes``); absent db_index flushes both;
+        pattern narrows to a subject-id prefix (reference: chassis
+        flush_cache + utils.ts flushACSCache)."""
         data = (payload or {}).get("data", payload) or {}
-        pattern = data.get("pattern", "")
-        count = 0
-        if self.cache is not None:
-            count = self.cache.evict_prefix(f"cache:{pattern}" if pattern else "")
-        return {"status": "flushed", "evicted": count}
+        pattern = data.get("pattern", "") or ""
+        db_index = data.get("db_index")
+        db_subject = self.cfg.get("redis:db-indexes:db-subject", 4)
+        db_acs = self.cfg.get("redis:db-indexes:db-acs", 5)
+        evicted = 0
+        flushed = {}
+        if self.cache is not None and db_index in (None, db_subject):
+            n = self.cache.evict_prefix(
+                f"cache:{pattern}" if pattern else ""
+            )
+            flushed["subject"] = n
+            evicted += n
+        if self.decision_cache is not None and db_index in (None, db_acs):
+            n = self.decision_cache.evict_pattern(pattern)
+            flushed["decisions"] = n
+            evicted += n
+        return {"status": "flushed", "evicted": evicted, "flushed": flushed}
 
     def metrics(self, payload: dict) -> dict:
         """Latency histograms + decision/path counters (SURVEY.md §5:

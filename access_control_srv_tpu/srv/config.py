@@ -19,7 +19,9 @@ DEFAULT_CONFIG: dict = {
     "authorization": {
         "enabled": False,
         "enforce": False,
-        "hrReqTimeout": 300_000,
+        # reference default is 300 000 ms (accessController.ts:753) — far
+        # too long to park a serving thread; operators can raise it back
+        "hrReqTimeout": 15_000,
     },
     "policies": {
         "type": "local",  # local | database
@@ -32,7 +34,18 @@ DEFAULT_CONFIG: dict = {
     },
     "seed_data": None,
     "server": {"transports": [{"provider": "grpc", "addr": "0.0.0.0:50061"}]},
-    "redis": {"db-indexes": {"db-subject": 4}},
+    # db-acs mirrors the reference acs-client decision cache living in
+    # Redis DB 5 (reference: cfg/config.json:254-259); flush_cache payloads
+    # route on these indexes (srv/command.py)
+    "redis": {"db-indexes": {"db-subject": 4, "db-acs": 5}},
+    # server-side decision cache (srv/decision_cache.py); ttl_s mirrors the
+    # reference's 3600 s TTL
+    "decision_cache": {
+        "enabled": True,
+        "ttl_s": 3600,
+        "max_entries": 65536,
+        "shards": 16,
+    },
     "adapter": {},
     "logger": {"maskFields": ["password", "token"]},
 }

@@ -1,0 +1,340 @@
+"""Server-side decision cache: TTL'd + LRU-bounded caching of
+``evaluation_cacheable`` decisions on the serving hot path.
+
+Framework analog of the reference ecosystem's acs-client decision cache
+(Redis DB 5, TTL 3600 — reference: cfg/config.json:254-259): the reference
+*clients* hash each access request and cache the decision when the response
+carries ``evaluation_cacheable``; here the cache lives server-side so every
+caller benefits and invalidation is driven by the same event surface the
+server already owns (CRUD hot-sync, ``userModified``/``userDeleted``,
+``flushCacheCommand``).
+
+Design:
+
+- **Keying** — a canonical request fingerprint: an order-insensitive hash
+  over the target's subject/resource/action attribute multisets plus a
+  canonical digest of the (already-resolved) request context.  The digest
+  covers subject id, role associations and hierarchical scopes, so a
+  subject whose associations change simply stops hitting its old entries
+  (content addressing backs up the explicit prefix eviction).  Keys embed
+  the subject id as a searchable prefix for ``userModified``/``userDeleted``
+  and the reference's ``flush_cache`` db_index/pattern payloads.
+- **Sharding + lock striping** — entries hash across N shards (power of
+  two), each an LRU-ordered dict behind its own lock, so batch-wide
+  lookups from concurrent serving threads never serialize on one mutex.
+- **TTL + LRU bound** — every entry expires ``ttl_s`` after write (lazily
+  collected on lookup); each shard holds at most ``max_entries / shards``
+  live entries, evicting least-recently-used beyond that.
+- **Epoch flush** — Rule/Policy/PolicySet CRUD, ``restore``/``reset``/
+  ``config_update`` and pattern-less ``flush_cache`` bump a global epoch;
+  entries written under an older epoch are logical misses (O(1) flush, no
+  lock sweep on the mutation path).
+
+The lookup path is host-only by construction: this module never imports
+jax and a cache hit returns before any encode or device dispatch
+(asserted by tpu_compat_audit.py and tests/test_decision_cache.py).
+
+Semantics bar: cache on/off must never change a decision — only responses
+whose ``evaluation_cacheable`` is True (every contributing rule cacheable,
+engine prefix-AND semantics) and whose operation status is 200 are stored,
+and the differential suite (tests/test_decision_cache.py) asserts
+bit-identical decision streams under randomized CRUD interleavings.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from hashlib import blake2b
+from typing import Any, Optional
+
+from ..core.common import get_field as _get
+from ..models.model import OperationStatus, Response
+
+_SEP = "\x1f"  # subject-id / digest separator inside keys
+
+
+def _canon(obj: Any) -> Any:
+    """Deterministic, hashable view of a JSON-ish value.  Dict key order is
+    normalized; list order is preserved (list order inside the context is
+    meaningful, e.g. role-association scoping instances); dataclass-like
+    objects (Attribute/Target leaking into merged contexts) degrade through
+    their ``__dict__``."""
+    if isinstance(obj, dict):
+        return tuple(
+            (k, _canon(v))
+            for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+        )
+    if isinstance(obj, (list, tuple)):
+        return tuple(_canon(v) for v in obj)
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if hasattr(obj, "__dict__"):
+        return _canon(
+            {k: v for k, v in vars(obj).items() if not k.startswith("_")}
+        )
+    return repr(obj)
+
+
+def _attr_key(attr) -> tuple:
+    nested = _get(attr, "attributes") or []
+    return (
+        _get(attr, "id") or "",
+        _get(attr, "value") or "",
+        tuple(sorted(repr(_attr_key(n)) for n in nested)),
+    )
+
+
+def _attr_multiset(attrs) -> tuple:
+    """Order-insensitive canonical form of one target attribute list."""
+    return tuple(sorted(repr(_attr_key(a)) for a in (attrs or [])))
+
+
+def request_fingerprint(request, subject_id_urn: str = "") -> Optional[str]:
+    """Canonical fingerprint of an access request, or None when the request
+    has no target (the engine's no-target deny path is never cached).
+
+    The context must already be resolved (token subject + HR scopes) —
+    callers fingerprint after ``engine.prepare_context`` so the key reflects
+    the attributes the evaluation will actually see.  The fingerprint is
+    memoized on the request object (``_dc_key``): serving builds a fresh
+    Request per RPC, while bench/batch callers re-submitting one object pay
+    the hash once.
+    """
+    memo = getattr(request, "_dc_key", None)
+    if memo is not None:
+        return memo
+    target = getattr(request, "target", None)
+    if target is None:
+        return None
+    context = getattr(request, "context", None) or {}
+    subject = _get(context, "subject") or {}
+    subject_id = _get(subject, "id") or ""
+    if not subject_id and subject_id_urn:
+        for attr in _get(target, "subjects") or []:
+            if _get(attr, "id") == subject_id_urn:
+                subject_id = _get(attr, "value") or ""
+                break
+    body = (
+        _attr_multiset(_get(target, "subjects")),
+        _attr_multiset(_get(target, "resources")),
+        _attr_multiset(_get(target, "actions")),
+        # derived keys the engine grafts during evaluation (_queryResult)
+        # are excluded: they are outputs of the walk, not request identity
+        _canon({
+            k: v for k, v in context.items()
+            if not (isinstance(k, str) and k.startswith("_"))
+        }) if isinstance(context, dict) else _canon(context),
+    )
+    digest = blake2b(repr(body).encode(), digest_size=16).hexdigest()
+    key = f"{subject_id}{_SEP}{digest}"
+    try:
+        request._dc_key = key
+    except Exception:  # exotic request objects without attribute support
+        pass
+    return key
+
+
+class _Shard:
+    __slots__ = ("lock", "entries")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # key -> (decision, obligations tuple, cacheable, code, message,
+        #         epoch, expires_at); OrderedDict order IS the LRU order
+        self.entries: OrderedDict[str, tuple] = OrderedDict()
+
+
+class DecisionCache:
+    """Sharded, lock-striped TTL + LRU decision cache with epoch flush."""
+
+    def __init__(
+        self,
+        ttl_s: float = 3600.0,
+        max_entries: int = 65536,
+        shards: int = 16,
+        enabled: bool = True,
+        telemetry=None,
+        time_fn=time.monotonic,
+    ):
+        n = 1
+        while n < max(1, int(shards)):
+            n <<= 1
+        self.enabled = bool(enabled)
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._shards = [_Shard() for _ in range(n)]
+        self._mask = n - 1
+        self._per_shard = max(1, self.max_entries // n)
+        self._time = time_fn
+        self.telemetry = telemetry
+        self._epoch = 0
+        self._stats_lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._stores = 0
+
+    # ---------------------------------------------------------------- stats
+
+    def _count(self, stat: str, by: int = 1) -> None:
+        with self._stats_lock:
+            setattr(self, f"_{stat}", getattr(self, f"_{stat}") + by)
+        if self.telemetry is not None:
+            self.telemetry.cache.inc(stat, by)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            hits, misses = self._hits, self._misses
+            evictions, stores = self._evictions, self._stores
+        lookups = hits + misses
+        return {
+            "enabled": self.enabled,
+            "hits": hits,
+            "misses": misses,
+            "evictions": evictions,
+            "stores": stores,
+            "hit_ratio": round(hits / lookups, 4) if lookups else None,
+            "entries": sum(len(s.entries) for s in self._shards),
+            "epoch": self._epoch,
+            "ttl_s": self.ttl_s,
+            "max_entries": self.max_entries,
+            "shards": len(self._shards),
+        }
+
+    # ----------------------------------------------------------------- core
+
+    def fingerprint(self, request, subject_id_urn: str = "") -> Optional[str]:
+        return request_fingerprint(request, subject_id_urn)
+
+    def _shard(self, key: str) -> _Shard:
+        # blake2b digests are uniformly distributed; Python's str hash is
+        # salted per process but stable within one, which is all striping
+        # needs
+        return self._shards[hash(key) & self._mask]
+
+    def get(self, key: Optional[str]) -> Optional[Response]:
+        """Return a rebuilt Response for a live entry, else None.  Misses
+        (absent, expired, stale-epoch) are counted; expired/stale entries
+        are collected in place."""
+        if not self.enabled or key is None:
+            return None
+        shard = self._shard(key)
+        epoch = self._epoch
+        now = self._time()
+        with shard.lock:
+            entry = shard.entries.get(key)
+            if entry is None:
+                self._count("misses")
+                return None
+            decision, obligations, cacheable, code, message, ent_epoch, exp = entry
+            if ent_epoch != epoch or exp <= now:
+                del shard.entries[key]
+                self._count("evictions")
+                self._count("misses")
+                return None
+            shard.entries.move_to_end(key)
+        self._count("hits")
+        # rebuild per hit: callers may hold the Response across a later
+        # eviction, so entries never hand out shared mutable state beyond
+        # the (treated-as-immutable) obligation attributes
+        return Response(
+            decision=decision,
+            obligations=list(obligations),
+            evaluation_cacheable=cacheable,
+            operation_status=OperationStatus(code=code, message=message),
+        )
+
+    def put(self, key: Optional[str], response: Response) -> bool:
+        """Write-through hook: stores only responses the engine marked
+        ``evaluation_cacheable`` with a 200 status.  Returns True when
+        stored."""
+        if not self.enabled or key is None or response is None:
+            return False
+        if response.evaluation_cacheable is not True:
+            return False
+        status = response.operation_status
+        if status is not None and status.code != 200:
+            return False
+        entry = (
+            response.decision,
+            tuple(response.obligations or ()),
+            True,
+            200,
+            status.message if status is not None else "success",
+            self._epoch,
+            self._time() + self.ttl_s,
+        )
+        shard = self._shard(key)
+        with shard.lock:
+            shard.entries[key] = entry
+            shard.entries.move_to_end(key)
+            while len(shard.entries) > self._per_shard:
+                shard.entries.popitem(last=False)
+                self._count("evictions")
+        self._count("stores")
+        return True
+
+    # ---------------------------------------------------------- invalidation
+
+    def bump_epoch(self) -> int:
+        """Logical full flush: policy-tree mutations (CRUD hot-sync,
+        restore/reset/config_update) call this; stale entries become misses
+        immediately and are collected lazily."""
+        with self._stats_lock:
+            self._epoch += 1
+            return self._epoch
+
+    def flush(self) -> int:
+        """Physical full flush (pattern-less ``flush_cache``); returns the
+        number of entries dropped."""
+        dropped = 0
+        for shard in self._shards:
+            with shard.lock:
+                dropped += len(shard.entries)
+                shard.entries.clear()
+        if dropped:
+            self._count("evictions", dropped)
+        self.bump_epoch()
+        return dropped
+
+    def evict_subject(self, subject_id: str) -> int:
+        """Drop every entry fingerprinted under ``subject_id``
+        (``userModified``/``userDeleted`` invalidation path)."""
+        if not subject_id:
+            return 0
+        return self._evict_prefix(subject_id + _SEP)
+
+    def evict_pattern(self, pattern: str) -> int:
+        """The reference ``flush_cache`` pattern semantics against the
+        subject-id prefix of the key space; empty pattern flushes all."""
+        if not pattern:
+            return self.flush()
+        return self._evict_prefix(pattern)
+
+    def _evict_prefix(self, prefix: str) -> int:
+        dropped = 0
+        for shard in self._shards:
+            with shard.lock:
+                stale = [k for k in shard.entries if k.startswith(prefix)]
+                for k in stale:
+                    del shard.entries[k]
+                dropped += len(stale)
+        if dropped:
+            self._count("evictions", dropped)
+        return dropped
+
+
+def from_config(cfg, telemetry=None) -> Optional[DecisionCache]:
+    """Build a DecisionCache from the ``decision_cache`` config block
+    (srv/config.py DEFAULT_CONFIG); None when disabled."""
+    block = cfg.get("decision_cache") or {}
+    if not block.get("enabled", True):
+        return None
+    return DecisionCache(
+        ttl_s=float(block.get("ttl_s", 3600.0)),
+        max_entries=int(block.get("max_entries", 65536)),
+        shards=int(block.get("shards", 16)),
+        telemetry=telemetry,
+    )

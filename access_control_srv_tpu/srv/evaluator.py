@@ -40,12 +40,19 @@ class HybridEvaluator:
         mesh=None,
         mesh_axis: str = "data",
         model_axis: str | None = None,
+        decision_cache=None,
     ):
         self.engine = engine
         self.backend = backend
         self.logger = logger
         self.telemetry = telemetry
         self.async_compile = async_compile
+        # server-side decision cache (srv/decision_cache.py): consulted
+        # batch-wide BEFORE encode so hit rows skip both the device
+        # round-trip and the oracle walk; written through from every miss
+        # row the engine marks evaluation_cacheable.  Policy mutations
+        # invalidate via refresh() -> bump_epoch below.
+        self.decision_cache = decision_cache
         # optional jax.sharding.Mesh: requests shard data-parallel over
         # ``mesh_axis`` while policy tensors replicate — the serving-path
         # multi-chip layout (the reference scales by running N stateless
@@ -79,6 +86,11 @@ class HybridEvaluator:
     def refresh(self, wait: bool = False) -> None:
         """Recompile the policy tensors after a tree mutation; the previous
         kernel serves until the swap."""
+        if self.decision_cache is not None:
+            # the tree changed (CRUD hot-sync / restore / reset / policy
+            # load): every cached decision is logically flushed BEFORE the
+            # new tree serves — a stale hit must never outlive the swap
+            self.decision_cache.bump_epoch()
         if self.backend == "oracle":
             # no compile, but the oracle walk still benefits from the
             # candidate index — in fact it is the mode where EVERY
@@ -239,7 +251,21 @@ class HybridEvaluator:
 
     def is_allowed(self, request) -> Response:
         """Single-request path: the oracle wins below batch sizes where the
-        device round-trip pays off."""
+        device round-trip pays off.  The decision cache is consulted first
+        — a warm cacheable request never pays the walk."""
+        cache = self.decision_cache
+        if cache is not None and cache.enabled:
+            self.engine.prepare_context(request)
+            key = cache.fingerprint(
+                request, self.engine.urns.get("subjectID") or ""
+            )
+            hit = cache.get(key)
+            if hit is not None:
+                self._count_path("cache-hit", 1)
+                return hit
+            response = self._oracle_is_allowed(request)
+            cache.put(key, response)
+            return response
         return self._oracle_is_allowed(request)
 
     def _oracle_is_allowed(self, request) -> Response:
@@ -314,6 +340,42 @@ class HybridEvaluator:
             self.telemetry.paths.inc(path, rows)
 
     def is_allowed_batch(self, requests: list) -> list[Response]:
+        """Batched decision path: decision-cache lookup batch-wide BEFORE
+        encode (hit rows skip the device round-trip and the oracle walk),
+        then the kernel/oracle hybrid over the miss rows, then write-through
+        of every miss row the engine marked ``evaluation_cacheable``."""
+        cache = self.decision_cache
+        if cache is None or not cache.enabled:
+            return self._is_allowed_batch_uncached(requests)
+        subject_urn = self.engine.urns.get("subjectID") or ""
+        responses: list[Optional[Response]] = [None] * len(requests)
+        keys: list = [None] * len(requests)
+        misses: list[int] = []
+        for b, request in enumerate(requests):
+            # fingerprints are taken AFTER context resolution so the key
+            # reflects the subject the evaluation will actually see (and
+            # so a userModified-driven re-resolution changes the key)
+            self.engine.prepare_context(request)
+            keys[b] = cache.fingerprint(request, subject_urn)
+            hit = cache.get(keys[b])
+            if hit is not None:
+                responses[b] = hit
+            else:
+                misses.append(b)
+        self._count_path("cache-hit", len(requests) - len(misses))
+        if misses:
+            computed = self._is_allowed_batch_uncached(
+                [requests[b] for b in misses]
+            )
+            for j, b in enumerate(misses):
+                responses[b] = computed[j]
+                # write-through from BOTH serving paths: kernel rows and
+                # oracle-fallback rows land here alike; put() keeps only
+                # cacheable 200s
+                cache.put(keys[b], computed[j])
+        return responses
+
+    def _is_allowed_batch_uncached(self, requests: list) -> list[Response]:
         with self._lock:
             kernel = self._kernel
             compiled = self._compiled
@@ -360,6 +422,7 @@ class HybridEvaluator:
         self._count_path("kernel", len(requests) - n_oracle)
         C = batch.cond_true.shape[0]
         responses: list[Response] = []
+        oracle_pending: list[tuple[int, object]] = []
         for b, request in enumerate(requests):
             if batch.eligible[b] and status[b] != 200:
                 # abort row: the pre-pass cached the condition error text;
@@ -386,8 +449,11 @@ class HybridEvaluator:
                     continue
             if not batch.eligible[b] or status[b] != 200:
                 # ineligible rows (and ambiguous abort rows) take the
-                # oracle path (candidate-filtered on large trees)
-                responses.append(self._oracle_is_allowed(request))
+                # oracle path (candidate-filtered on large trees);
+                # resolved together below so adapter-backed rows can fan
+                # out concurrently
+                oracle_pending.append((len(responses), request))
+                responses.append(None)
                 continue
             cach = None if cacheable[b] < 0 else bool(cacheable[b])
             responses.append(
@@ -398,4 +464,25 @@ class HybridEvaluator:
                     operation_status=OperationStatus(),
                 )
             )
+        if oracle_pending:
+            rows = [req for _, req in oracle_pending]
+            adapter = self.engine.resource_adapter
+            if adapter is not None and len(rows) > 1:
+                # adapter-backed fallback rows block on remote context
+                # queries — fan the walks out so the batch stalls for at
+                # most ~one endpoint timeout instead of N sequential ones
+                # (the adapter's transport is pooled + timeout-bounded,
+                # srv/adapters.py)
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = min(
+                    len(rows),
+                    int(getattr(adapter, "max_concurrency", 8) or 8),
+                )
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    results = list(pool.map(self._oracle_is_allowed, rows))
+            else:
+                results = [self._oracle_is_allowed(r) for r in rows]
+            for (slot, _), response in zip(oracle_pending, results):
+                responses[slot] = response
         return responses

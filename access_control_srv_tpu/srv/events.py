@@ -72,6 +72,22 @@ class EventBus:
         return dict(self._topics)
 
 
+def on_topics(bus, topic_names, listener) -> None:
+    """Subscribe one listener to several topics (no replay).  Used to fan
+    the policy CRUD topics into cross-cutting listeners — e.g. the
+    decision-cache epoch flush, which must fire on REMOTE workers' frames
+    immediately rather than waiting out the replicator's debounced tree
+    sync (srv/worker.py)."""
+    for name in topic_names:
+        bus.topic(name).on(listener)
+
+
+CRUD_TOPICS = tuple(
+    f"io.restorecommerce.{kind}s.resource"
+    for kind in ("rule", "policy", "policy_set")
+)
+
+
 class OffsetStore:
     """Consumer-offset checkpoints (reference: chassis OffsetStore over
     Redis DB 0, src/worker.ts:123)."""

@@ -562,6 +562,12 @@ class PolicyStore:
                 for i, p in enumerate(child_policies)
             }
             tree[policy_set.id] = policy_set
+        decision_cache = getattr(self.evaluator, "decision_cache", None)
+        if decision_cache is not None:
+            # epoch-flush BEFORE the swap: between the new tree going live
+            # and the evaluator refresh below, no cached old-tree decision
+            # may serve (refresh bumps again — double bump is harmless)
+            decision_cache.bump_epoch()
         self.engine.replace_policy_sets(tree)
         if self.evaluator is not None:
             self.evaluator.refresh()

@@ -196,7 +196,8 @@ class Telemetry:
         self.what_is_allowed_latency = Histogram()
         self.batch_latency = Histogram()
         self.decisions = Counter()
-        self.paths = Counter()  # kernel / oracle / native-wire rows
+        self.paths = Counter()  # kernel / oracle / native-wire / cache-hit rows
+        self.cache = Counter()  # decision-cache hits / misses / evictions
         self.start_time = time.time()
 
     @contextmanager
@@ -221,6 +222,7 @@ class Telemetry:
             "batch_latency": self.batch_latency.snapshot(),
             "decisions": self.decisions.snapshot(),
             "paths": self.paths.snapshot(),
+            "decision_cache": self.cache.snapshot(),
         }
 
 

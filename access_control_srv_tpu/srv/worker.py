@@ -16,8 +16,9 @@ from .batcher import MicroBatcher
 from .cache import HRScopeProvider, SubjectCache, compare_role_associations
 from .command import CommandInterface
 from .config import Config
+from .decision_cache import from_config as decision_cache_from_config
 from .evaluator import HybridEvaluator
-from .events import EventBus, OffsetStore
+from .events import CRUD_TOPICS, EventBus, OffsetStore, on_topics
 from .identity import StaticIdentityClient
 from .service import AccessControlService
 from .store import PolicyStore
@@ -50,6 +51,7 @@ class Worker:
         self.batcher: Optional[MicroBatcher] = None
         self.bus: Optional[EventBus] = None
         self.subject_cache: Optional[SubjectCache] = None
+        self.decision_cache = None
         self.hr_provider: Optional[HRScopeProvider] = None
         self.identity_client = None
         self.offset_store: Optional[OffsetStore] = None
@@ -126,8 +128,14 @@ class Worker:
         self.hr_provider = HRScopeProvider(
             self.subject_cache,
             auth_topic,
-            timeout_ms=cfg.get("authorization:hrReqTimeout", 300_000),
+            timeout_ms=cfg.get("authorization:hrReqTimeout", 15_000),
             logger=self.logger,
+        )
+        # server-side decision cache (srv/decision_cache.py): TTL +
+        # LRU-bounded cache of evaluation_cacheable decisions, invalidated
+        # by CRUD epoch bumps, user events and flush_cache commands
+        self.decision_cache = decision_cache_from_config(
+            cfg, telemetry=self.telemetry
         )
 
         # identity client: a live gRPC channel when the config names an
@@ -249,6 +257,7 @@ class Worker:
             mesh=mesh,
             mesh_axis=cfg.get("parallel:axis", "data"),
             model_axis=model_axis,
+            decision_cache=self.decision_cache,
         )
 
         # policy store with self-authorization hook; the hook consults the
@@ -275,6 +284,7 @@ class Worker:
             store=self.store,
             bus=self.bus,
             cache=self.subject_cache,
+            decision_cache=self.decision_cache,
             logger=self.logger,
         )
         self.batcher = MicroBatcher(
@@ -290,6 +300,13 @@ class Worker:
         self.bus.topic("io.restorecommerce.users.resource").on(
             self._user_listener
         )
+        if self.decision_cache is not None:
+            # CRUD frames flush cached decisions the moment they land —
+            # including REMOTE workers' frames, which otherwise only take
+            # effect at the replicator's debounced tree sync (local
+            # mutations bump again through store hot-sync; double bumps
+            # are harmless)
+            on_topics(self.bus, CRUD_TOPICS, self._crud_cache_listener)
 
         # seed data (reference: src/worker.ts:200-242)
         seed_cfg = cfg.get("seed_data")
@@ -348,17 +365,31 @@ class Worker:
                 message, subject_resolver=self.identity_client.find_by_token
             )
 
+    def _crud_cache_listener(self, event_name: str, message, ctx: dict) -> None:
+        """Rule/Policy/PolicySet Created/Modified/Deleted -> decision-cache
+        epoch flush (tree mutations make every cached decision suspect)."""
+        if event_name.endswith(("Created", "Modified", "Deleted")):
+            self.decision_cache.bump_epoch()
+
     def _user_listener(self, event_name: str, message, ctx: dict) -> None:
-        """userModified / userDeleted -> subject-cache eviction
-        (reference: src/worker.ts:300-345)."""
+        """userModified / userDeleted -> subject-cache + decision-cache
+        eviction (reference: src/worker.ts:300-345)."""
         if event_name == "userDeleted":
             user_id = (message or {}).get("id")
             if user_id:
                 self.hr_provider.evict_hr_scopes(user_id)
+                if self.decision_cache is not None:
+                    self.decision_cache.evict_subject(user_id)
         elif event_name == "userModified":
             user_id = (message or {}).get("id")
             if not user_id:
                 return
+            # cached decisions fingerprint the subject's resolved role
+            # associations, so changed-assoc requests miss anyway — the
+            # prefix eviction also clears entries for the OLD associations
+            # (reference analog: utils.ts flushACSCache on user mutation)
+            if self.decision_cache is not None:
+                self.decision_cache.evict_subject(user_id)
             # token resolutions for a mutated user are stale regardless of
             # role-association diffing
             if hasattr(self.identity_client, "evict"):

@@ -528,11 +528,14 @@ def bench_hr_deep():
 # ------------------------------------------------- config 5: 100k-rule stress
 
 
-def _stress_engine(n_rules: int, scoped: bool = False):
+def _stress_engine(n_rules: int, scoped: bool = False,
+                   cacheable: bool = False):
     """Synthetic tree: deny-overrides set of permit-overrides policies,
     role/entity/action-targeted rules with interleaved PERMIT/DENY.
     ``scoped=True`` adds a roleScopingEntity to every rule's role subject
-    (stage B non-trivial tree-wide: the enterprise shape)."""
+    (stage B non-trivial tree-wide: the enterprise shape).
+    ``cacheable=True`` marks every rule ``evaluation_cacheable`` (the
+    decision-cache warm-traffic shape)."""
     from access_control_srv_tpu.core.loader import load_policy_sets
     from access_control_srv_tpu.core import AccessController
     from access_control_srv_tpu.models import Urns
@@ -568,6 +571,7 @@ def _stress_engine(n_rules: int, scoped: bool = False):
                         ],
                     },
                     "effect": "PERMIT" if rid % 3 else "DENY",
+                    "evaluation_cacheable": cacheable,
                 }
             )
             rid += 1
@@ -921,21 +925,20 @@ def bench_serving_latency():
         worker.stop()
 
 
-def bench_adapter_mixed():
-    """Adapter-mixed traffic (VERDICT r4 item 8): a tree where some
-    rules carry context queries + conditions, an adapter configured, and
-    ~20% of requests hitting those rules — quantifies the per-row oracle
-    degradation the encoder applies to condition+context-query rows."""
+def _adapter_mixed_setup(cacheable: bool = False):
+    """Shared corpus for the adapter-mixed benches: a stress tree plus
+    context-query rules over 8 of the 64 entities, a stub adapter, and a
+    uniform request draw.  Returns (engine, actual_rules, requests,
+    chunk)."""
     import numpy as np
 
     from access_control_srv_tpu.core.loader import load_policy_sets
     from access_control_srv_tpu.models import Attribute, Request, Target, Urns
-    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
 
     urns = Urns()
     n_rules = int(os.environ.get("MIXED_RULES", 10_000))
     chunk = int(os.environ.get("MIXED_CHUNK", 8192))
-    engine, actual = _stress_engine(n_rules)
+    engine, actual = _stress_engine(n_rules, cacheable=cacheable)
     # graft context-query rules over 8 of the 64 entities (~12.5% of the
     # entity space; requests drawn uniformly hit them ~12-20%).  Two-digit
     # entity indices only: the regex-candidacy pre-filter treats entity
@@ -959,6 +962,7 @@ def bench_adapter_mixed():
                     "query": "query q { all { id } }",
                 },
                 "condition": "len(context._queryResult) > 0",
+                "evaluation_cacheable": cacheable,
             }],
         })
     doc = {"policy_sets": [{
@@ -972,7 +976,6 @@ def bench_adapter_mixed():
             return [{"id": "res"}]
 
     engine.resource_adapter = Adapter()
-    evaluator = HybridEvaluator(engine, backend="hybrid")
     rng = np.random.default_rng(23)
     requests = []
     for i in range(chunk):
@@ -996,6 +999,18 @@ def bench_adapter_mixed():
                 "hierarchical_scopes": [],
             }},
         ))
+    return engine, actual, requests, chunk
+
+
+def bench_adapter_mixed():
+    """Adapter-mixed traffic (VERDICT r4 item 8): a tree where some
+    rules carry context queries + conditions, an adapter configured, and
+    ~20% of requests hitting those rules — quantifies the per-row oracle
+    degradation the encoder applies to condition+context-query rows."""
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    engine, actual, requests, chunk = _adapter_mixed_setup()
+    evaluator = HybridEvaluator(engine, backend="hybrid")
     out = evaluator.is_allowed_batch(requests)  # warmup + compile
     assert len(out) == chunk
     from access_control_srv_tpu.ops.encode import encode_requests
@@ -1018,6 +1033,78 @@ def bench_adapter_mixed():
     )
 
 
+def bench_adapter_mixed_warm():
+    """Warm-cache adapter-mixed traffic: the same corpus with every rule
+    marked ``evaluation_cacheable`` and the server-side decision cache
+    enabled (srv/decision_cache.py).  The cold pass writes through; warm
+    passes serve repeat traffic from the cache — the headline value is the
+    cacheable fraction's throughput (cache-hit rows only), the quantity
+    the reference ecosystem buys with its Redis DB5 client cache."""
+    import copy
+
+    from access_control_srv_tpu.srv.decision_cache import DecisionCache
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    engine, actual, requests, chunk = _adapter_mixed_setup(cacheable=True)
+    cache = DecisionCache(ttl_s=3600.0, max_entries=1 << 17)
+    evaluator = HybridEvaluator(engine, backend="hybrid",
+                                decision_cache=cache)
+    cold = evaluator.is_allowed_batch(requests)  # compile + write-through
+    assert len(cold) == chunk
+    # bit-identity spot check: warm hits must equal the cold decisions
+    warm_check = evaluator.is_allowed_batch(
+        [copy.deepcopy(r) for r in requests[:256]]
+    )
+    assert [r.decision for r in warm_check] == \
+        [r.decision for r in cold[:256]]
+
+    # the setup deep-copied a 10k-rule tree during compile: drain that
+    # garbage now or a single gen-2 GC pause (~100ms on this object
+    # graph) lands inside a ~15ms timed pass and halves the measurement
+    import gc
+
+    gc.collect()
+
+    # mixed warm traffic: hits + the non-cacheable (INDETERMINATE) rest
+    iters = max(1, int(os.environ.get("MIXED_TOTAL", 32768)) // chunk)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        evaluator.is_allowed_batch(requests)
+    mixed_qps = chunk * iters / (time.perf_counter() - t0)
+
+    # cacheable fraction alone: every row below was written through by the
+    # cold pass, so this measures pure cache-hit serving
+    cacheable_rows = [
+        r for r, resp in zip(requests, cold)
+        if resp.evaluation_cacheable is True
+    ]
+    hits_before = cache.stats()["hits"]
+    gc.collect()
+    warm_iters = max(16, iters)  # amortize residual GC over the passes
+    t0 = time.perf_counter()
+    for _ in range(warm_iters):
+        evaluator.is_allowed_batch(cacheable_rows)
+    cacheable_qps = len(cacheable_rows) * warm_iters / \
+        (time.perf_counter() - t0)
+    hits = cache.stats()["hits"] - hits_before
+    assert hits == len(cacheable_rows) * warm_iters, (
+        "warm cacheable rows must all be served from cache"
+    )
+    stats = cache.stats()
+    return _result(
+        f"isAllowed decisions/sec (adapter-mixed WARM decision cache, "
+        f"{actual + 8}-rule tree, cacheable fraction)",
+        cacheable_qps,
+        "decisions/s",
+        {"rules": actual + 8, "batch": chunk,
+         "cacheable_rows": len(cacheable_rows),
+         "cacheable_pct": round(100.0 * len(cacheable_rows) / chunk, 1),
+         "mixed_warm_qps": round(mixed_qps, 1),
+         "hit_ratio": stats["hit_ratio"],
+         "cache_entries": stats["entries"]},
+    )
+
+
 HOST_ONLY = {"scalar", "wia"}
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -1025,7 +1112,8 @@ ACCEL_OK = True  # cleared by main() when the backend probe fails
 def main():
     which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
                              "hr-deep", "stress", "stress-hr", "serve",
-                             "serve-latency", "adapter-mixed"]
+                             "serve-latency", "adapter-mixed",
+                             "adapter-mixed-warm"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -1103,6 +1191,7 @@ def main():
         "serve": bench_serving_e2e,
         "serve-latency": bench_serving_latency,
         "adapter-mixed": bench_adapter_mixed,
+        "adapter-mixed-warm": bench_adapter_mixed_warm,
     }
     for name in which:
         row = fns[name]()

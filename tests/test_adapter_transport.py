@@ -1,0 +1,233 @@
+"""Adapter transport (ISSUE 1 satellite): the pooled keep-alive HTTP
+transport replacing the per-row ``urllib.urlopen``, the configurable
+timeout, ``query_many`` batch concurrency with per-row error semantics,
+and the HR-rendezvous shared-condition wakeup."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from access_control_srv_tpu.core.errors import UnexpectedContextQueryResponse
+from access_control_srv_tpu.models import Request, Target
+from access_control_srv_tpu.srv.adapters import GraphQLAdapter, create_adapter
+from access_control_srv_tpu.srv.cache import HRScopeProvider, SubjectCache
+
+GQL_BODY = json.dumps({
+    "data": {"op": {"details": [{"payload": {"id": "res-1"}}]}}
+}).encode()
+
+
+class _GqlHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive, like real gql endpoints
+    delay_s = 0.0
+    connections = set()
+
+    def do_POST(self):
+        self.connections.add(self.client_address)
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(GQL_BODY)))
+        self.end_headers()
+        self.wfile.write(GQL_BODY)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def gql_server():
+    handler = type("Handler", (_GqlHandler,), {"connections": set(),
+                                               "delay_s": 0.0})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}/graphql", handler
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def context_query():
+    return SimpleNamespace(query="query q { all { id } }", filters=[])
+
+
+def request():
+    return Request(target=Target(subjects=[], resources=[], actions=[]),
+                   context={"resources": []})
+
+
+def test_pooled_transport_reuses_connections(gql_server):
+    url, handler = gql_server
+    adapter = GraphQLAdapter(url)
+    try:
+        for _ in range(6):
+            assert adapter.query(context_query(), request()) == \
+                [{"id": "res-1"}]
+        # keep-alive pooling: 6 sequential queries ride ONE connection
+        # (the old urllib transport opened 6)
+        assert len(handler.connections) == 1
+    finally:
+        adapter.close()
+
+
+def test_query_many_fans_out_concurrently(gql_server):
+    url, handler = gql_server
+    handler.delay_s = 0.25
+    adapter = GraphQLAdapter(url, max_concurrency=4)
+    try:
+        pairs = [(context_query(), request()) for _ in range(4)]
+        t0 = time.perf_counter()
+        results = adapter.query_many(pairs)
+        elapsed = time.perf_counter() - t0
+        assert results == [[{"id": "res-1"}]] * 4
+        # 4 rows at 0.25s each: sequential would be ~1.0s
+        assert elapsed < 0.75, f"batch not concurrent: {elapsed:.2f}s"
+    finally:
+        adapter.close()
+
+
+def test_query_many_per_row_errors(gql_server):
+    url, _ = gql_server
+    adapter = GraphQLAdapter(url)
+    bad = SimpleNamespace(query="q", filters=[])
+    calls = {"n": 0}
+    real = adapter.transport
+
+    def flaky(u, body, headers):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return b"not json"
+        return real(u, body, headers)
+
+    adapter.transport = flaky
+    try:
+        results = adapter.query_many(
+            [(bad, request()), (context_query(), request())]
+        )
+        # row 0 failed, row 1 served: deny-on-error stays per-row
+        assert isinstance(results[0], UnexpectedContextQueryResponse)
+        assert results[1] == [{"id": "res-1"}]
+    finally:
+        adapter.close()
+
+
+def test_configurable_timeout_bounds_slow_endpoint(gql_server):
+    url, handler = gql_server
+    handler.delay_s = 5.0
+    adapter = GraphQLAdapter(url, timeout_s=0.3)
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(Exception):
+            adapter.query(context_query(), request())
+        # far below the old hard-coded 30s urlopen timeout
+        assert time.perf_counter() - t0 < 2.0
+    finally:
+        adapter.close()
+
+
+def test_create_adapter_passes_transport_knobs():
+    adapter = create_adapter({
+        "graphql": {"url": "http://example.invalid/graphql"},
+        "timeout_s": 1.5,
+        "max_concurrency": 3,
+    })
+    assert adapter.timeout_s == 1.5
+    assert adapter.max_concurrency == 3
+
+
+# ------------------------------------------------- HR rendezvous wakeup
+
+
+def test_hr_rendezvous_wakes_all_parked_waiters(monkeypatch):
+    """N threads parked on the same token_date share ONE condition and all
+    wake on a single hierarchicalScopesResponse (the satellite replacing
+    one threading.Event per request)."""
+    import access_control_srv_tpu.srv.cache as cache_mod
+
+    # pin the rendezvous timestamp so all four calls share one token_date
+    class FixedNow:
+        @staticmethod
+        def isoformat():
+            return "FIXED"
+
+    class FixedDatetime:
+        @staticmethod
+        def now(tz):
+            return FixedNow()
+
+    import datetime as real_datetime
+
+    monkeypatch.setattr(
+        cache_mod, "datetime",
+        SimpleNamespace(datetime=FixedDatetime,
+                        timezone=real_datetime.timezone),
+    )
+
+    requests_seen = []
+    topic = SimpleNamespace(
+        emit=lambda event, message: requests_seen.append(message["token"])
+    )
+    provider = HRScopeProvider(SubjectCache(), topic, timeout_ms=5_000)
+
+    def subject():
+        return {"id": "u1", "token": "tok-1",
+                "tokens": [{"token": "tok-1"}]}
+
+    results = []
+
+    def waiter():
+        results.append(provider.create_hr_scope({"subject": subject()}))
+
+    threads = [threading.Thread(target=waiter) for _ in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 2.0
+    while provider.waiting.get("tok-1:FIXED", 0) < 4 and \
+            time.time() < deadline:
+        time.sleep(0.01)
+    assert provider.waiting.get("tok-1:FIXED") == 4
+    # ONE response wakes all four parked waiters
+    provider.handle_hr_scopes_response({
+        "token": "tok-1:FIXED",
+        "subject_id": "u1",
+        "hierarchical_scopes": [{"id": "root-org"}],
+    })
+    for t in threads:
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "parked waiter never woke"
+    assert len(results) == 4
+    for ctx in results:
+        assert ctx["subject"]["hierarchical_scopes"] == [{"id": "root-org"}]
+    # bookkeeping drained: neither the waiting map nor the released set
+    # leaks entries after the last waiter exits
+    assert provider.waiting == {}
+    assert provider._released == set()
+
+
+def test_hr_rendezvous_timeout_unparks():
+    provider = HRScopeProvider(
+        SubjectCache(),
+        SimpleNamespace(emit=lambda *a, **k: None),
+        timeout_ms=100,
+    )
+    context = {"subject": {"id": "u1", "token": "tok-1"}}
+    t0 = time.perf_counter()
+    out = provider.create_hr_scope(context)
+    assert time.perf_counter() - t0 < 2.0
+    assert out is context or out == context
+    assert provider.waiting == {}
+
+
+def test_default_hr_timeout_lowered():
+    from access_control_srv_tpu.srv.config import DEFAULT_CONFIG
+
+    assert DEFAULT_CONFIG["authorization"]["hrReqTimeout"] == 15_000
+    assert HRScopeProvider(SubjectCache()).timeout_ms == 15_000

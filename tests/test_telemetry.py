@@ -109,6 +109,32 @@ def test_paths_counter_instrumented():
         w.stop()
 
 
+def test_health_check_reports_decision_cache_counters():
+    """Decision-cache hits/misses/evictions + hit ratio surface on BOTH
+    operator surfaces: the health_check payload and the telemetry snapshot
+    (ISSUE 1 satellite: cache efficacy must be observable)."""
+    w = Worker().start(seed_cfg())
+    try:
+        w.service.is_allowed(admin_request())  # cold: miss + write-through
+        w.service.is_allowed(admin_request())  # warm: hit
+        health = w.command_interface.command("health_check")
+        dc = health["decision_cache"]
+        assert dc["hits"] >= 1
+        assert dc["misses"] >= 1
+        assert dc["stores"] >= 1
+        assert 0.0 < dc["hit_ratio"] <= 1.0
+        assert dc["entries"] >= 1
+        # the same counters flow through the Telemetry.cache counter into
+        # the metrics snapshot
+        snap = w.telemetry.snapshot()["decision_cache"]
+        assert snap.get("hits", 0) == dc["hits"]
+        assert snap.get("misses", 0) == dc["misses"]
+        # cache-hit rows are attributed on the serving-path counter too
+        assert w.telemetry.paths.snapshot().get("cache-hit", 0) >= 1
+    finally:
+        w.stop()
+
+
 def test_mask_namedtuple_survives():
     from collections import namedtuple
 

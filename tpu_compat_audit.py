@@ -170,6 +170,62 @@ def main() -> int:
                         "signature-cache hits, no program dispatched")}
     results.append(row)
 
+    # 4. decision-cache lookup path must stay host-only: the module may
+    # not import jax, and a warm cache hit must answer without ANY device
+    # dispatch (kernel.evaluate stubbed to fail) or new device transfer
+    import access_control_srv_tpu.srv.decision_cache as dc_mod
+    from access_control_srv_tpu.srv.decision_cache import DecisionCache
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+    dc_src = open(dc_mod.__file__).read()
+    imports_jax = re.search(r"^\s*(import|from)\s+jax\b", dc_src, re.M)
+    cache = DecisionCache(ttl_s=3600.0)
+    engine3, _ = bench_all._stress_engine(512, cacheable=True)
+    hybrid = HybridEvaluator(engine3, decision_cache=cache)
+    reqs3 = []
+    for i in range(16):
+        reqs3.append(Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"], value=f"role-{i % 7}"),
+                          Attribute(id=urns["subjectID"], value=f"u{i}")],
+                resources=[Attribute(
+                    id=urns["entity"],
+                    value=f"urn:restorecommerce:acs:model:stress{i % 8}"
+                          f".Stress{i % 8}",
+                )],
+                actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+            ),
+            context={"resources": [], "subject": {
+                "id": f"u{i}",
+                "role_associations": [{"role": f"role-{i % 7}",
+                                       "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        ))
+    warm = hybrid.is_allowed_batch(reqs3)  # write-through
+
+    class _NoDevice:
+        def evaluate(self, batch):
+            raise AssertionError("cache hit reached the device")
+
+    hybrid._kernel = _NoDevice()
+    hybrid._native_encoder = None
+    cacheable_rows = [r for r, resp in zip(reqs3, warm)
+                      if resp.evaluation_cacheable is True]
+    served = hybrid.is_allowed_batch(cacheable_rows)  # must not dispatch
+    hits_ok = (
+        len(served) == len(cacheable_rows)
+        and all(a.decision == b.decision for a, b in zip(
+            served, [w for w in warm if w.evaluation_cacheable is True]))
+        and cache.stats()["hits"] >= len(cacheable_rows)
+    )
+    results.append({
+        "kernel": "decision-cache-lookup",
+        "ok": bool(hits_ok and not imports_jax and cacheable_rows),
+        "note": ("host-only: module imports no jax; warm hits served with "
+                 f"kernel stubbed out ({len(cacheable_rows)} rows)"),
+    })
+
     verdict = {
         "backend": backend,
         "device": str(jax.devices()[0]),
