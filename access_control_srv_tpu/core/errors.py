@@ -43,6 +43,20 @@ class UnexpectedContextQueryResponse(AccessControlError):
         super().__init__(f"Unexpected context query response: {detail}")
 
 
+class ContextQueryTransportError(AccessControlError):
+    """Non-2xx HTTP response from a context-query endpoint.  Carries the
+    upstream status as ``code`` so the engine's deny-on-error branch keeps
+    the transport's classification (the old ``urllib.urlopen`` transport
+    raised ``HTTPError`` with the same ``code`` here) instead of feeding
+    an error body into GraphQL parsing."""
+
+    def __init__(self, status: int, reason: str = ""):
+        super().__init__(
+            f"Context query endpoint returned {status} {reason}".rstrip()
+        )
+        self.code = int(status)
+
+
 class ConditionEvaluationError(AccessControlError):
     """Raised when a rule condition fails to evaluate; the engine converts
     this into a deny-by-default response (reference:

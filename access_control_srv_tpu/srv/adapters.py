@@ -30,7 +30,11 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 from ..core.common import get_field as _get
-from ..core.errors import UnexpectedContextQueryResponse, UnsupportedResourceAdapter
+from ..core.errors import (
+    ContextQueryTransportError,
+    UnexpectedContextQueryResponse,
+    UnsupportedResourceAdapter,
+)
 
 DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_MAX_CONCURRENCY = 8
@@ -47,6 +51,7 @@ class _ConnectionPool:
     discarded and the request retried once on a fresh one."""
 
     def __init__(self, url: str, timeout_s: float, max_idle: int = 8):
+        self.url = url
         parsed = urllib.parse.urlsplit(url)
         self.scheme = parsed.scheme or "http"
         self.host = parsed.hostname or ""
@@ -100,7 +105,12 @@ class _ConnectionPool:
             except Exception:
                 conn.close()
                 raise
+        # the body is fully read, so the connection is reusable either way
         self._checkin(conn)
+        if not 200 <= response.status < 300:
+            # error bodies (often HTML) must never reach GraphQL parsing:
+            # surface a clean transport error with the upstream status
+            raise ContextQueryTransportError(response.status, response.reason)
         return data
 
     def close(self) -> None:
@@ -140,7 +150,13 @@ class GraphQLAdapter(ResourceAdapter):
 
     def _http_post(self, url: str, body: bytes, headers: dict) -> bytes:
         with self._pool_lock:
-            if self._pool is None or self._pool.timeout_s != self.timeout_s:
+            if (
+                self._pool is None
+                or self._pool.url != url
+                or self._pool.timeout_s != self.timeout_s
+            ):
+                if self._pool is not None:
+                    self._pool.close()
                 self._pool = _ConnectionPool(url, self.timeout_s)
             pool = self._pool
         return pool.post(body, headers)

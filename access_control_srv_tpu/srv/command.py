@@ -136,8 +136,20 @@ class CommandInterface:
         data = (payload or {}).get("data", payload) or {}
         pattern = data.get("pattern", "") or ""
         db_index = data.get("db_index")
-        db_subject = self.cfg.get("redis:db-indexes:db-subject", 4)
-        db_acs = self.cfg.get("redis:db-indexes:db-acs", 5)
+        db_subject = int(self.cfg.get("redis:db-indexes:db-subject", 4))
+        db_acs = int(self.cfg.get("redis:db-indexes:db-acs", 5))
+        if db_index is not None:
+            # loosely-typed JSON payloads send "5": coerce before routing
+            # so a string index never silently flushes nothing
+            try:
+                db_index = int(db_index)
+            except (TypeError, ValueError):
+                return {"error": f"invalid db_index {db_index!r}"}
+            if db_index not in (db_subject, db_acs):
+                return {
+                    "error": f"unrecognized db_index {db_index} "
+                             f"(expected {db_subject} or {db_acs})"
+                }
         evicted = 0
         flushed = {}
         if self.cache is not None and db_index in (None, db_subject):

@@ -208,6 +208,15 @@ class DecisionCache:
     def fingerprint(self, request, subject_id_urn: str = "") -> Optional[str]:
         return request_fingerprint(request, subject_id_urn)
 
+    @property
+    def epoch(self) -> int:
+        """Current tree epoch.  Writers snapshot this BEFORE computing a
+        decision and hand the snapshot back to :meth:`put` — a decision
+        whose evaluation spans an epoch bump (CRUD hot-sync / restore
+        completing mid-walk) is then stored under the old epoch and is a
+        logical miss, never served as fresh."""
+        return self._epoch
+
     def _shard(self, key: str) -> _Shard:
         # blake2b digests are uniformly distributed; Python's str hash is
         # salted per process but stable within one, which is all striping
@@ -246,10 +255,25 @@ class DecisionCache:
             operation_status=OperationStatus(code=code, message=message),
         )
 
-    def put(self, key: Optional[str], response: Response) -> bool:
+    def put(
+        self, key: Optional[str], response: Response,
+        epoch: Optional[int] = None,
+    ) -> bool:
         """Write-through hook: stores only responses the engine marked
         ``evaluation_cacheable`` with a 200 status.  Returns True when
-        stored."""
+        stored.
+
+        ``epoch`` is the writer's :attr:`epoch` snapshot taken at
+        lookup/miss time, BEFORE the evaluation read the policy tree.  The
+        entry is stamped with that snapshot (not the epoch at write time):
+        if a tree mutation bumped the epoch while the decision was being
+        computed, the entry is born stale — stored here only to be a
+        logical miss — so an old-tree decision (e.g. a revoked permit)
+        can never be served as fresh for a TTL.  A snapshot already known
+        stale is refused outright rather than pushing a live LRU entry
+        out.  ``None`` (direct/test callers whose compute did not span a
+        mutation) stamps the current epoch, matching a snapshot taken
+        now."""
         if not self.enabled or key is None or response is None:
             return False
         if response.evaluation_cacheable is not True:
@@ -257,13 +281,16 @@ class DecisionCache:
         status = response.operation_status
         if status is not None and status.code != 200:
             return False
+        ent_epoch = self._epoch if epoch is None else int(epoch)
+        if ent_epoch != self._epoch:
+            return False
         entry = (
             response.decision,
             tuple(response.obligations or ()),
             True,
             200,
             status.message if status is not None else "success",
-            self._epoch,
+            ent_epoch,
             self._time() + self.ttl_s,
         )
         shard = self._shard(key)

@@ -255,6 +255,11 @@ class HybridEvaluator:
         — a warm cacheable request never pays the walk."""
         cache = self.decision_cache
         if cache is not None and cache.enabled:
+            # epoch snapshot BEFORE the walk reads the tree: if a CRUD /
+            # restore bump lands while this decision is in flight, the
+            # write-through below stores a born-stale entry (logical miss)
+            # instead of serving an old-tree decision as fresh
+            epoch = cache.epoch
             self.engine.prepare_context(request)
             key = cache.fingerprint(
                 request, self.engine.urns.get("subjectID") or ""
@@ -264,7 +269,7 @@ class HybridEvaluator:
                 self._count_path("cache-hit", 1)
                 return hit
             response = self._oracle_is_allowed(request)
-            cache.put(key, response)
+            cache.put(key, response, epoch=epoch)
             return response
         return self._oracle_is_allowed(request)
 
@@ -348,6 +353,10 @@ class HybridEvaluator:
         if cache is None or not cache.enabled:
             return self._is_allowed_batch_uncached(requests)
         subject_urn = self.engine.urns.get("subjectID") or ""
+        # one epoch snapshot for the whole batch, taken before any row
+        # reads the tree: rows whose evaluation spans a concurrent epoch
+        # bump are written through born-stale (see DecisionCache.put)
+        epoch = cache.epoch
         responses: list[Optional[Response]] = [None] * len(requests)
         keys: list = [None] * len(requests)
         misses: list[int] = []
@@ -372,7 +381,7 @@ class HybridEvaluator:
                 # write-through from BOTH serving paths: kernel rows and
                 # oracle-fallback rows land here alike; put() keeps only
                 # cacheable 200s
-                cache.put(keys[b], computed[j])
+                cache.put(keys[b], computed[j], epoch=epoch)
         return responses
 
     def _is_allowed_batch_uncached(self, requests: list) -> list[Response]:

@@ -566,7 +566,12 @@ class PolicyStore:
         if decision_cache is not None:
             # epoch-flush BEFORE the swap: between the new tree going live
             # and the evaluator refresh below, no cached old-tree decision
-            # may serve (refresh bumps again — double bump is harmless)
+            # may serve.  refresh() bumps AGAIN after the swap — together
+            # with writers stamping entries with an epoch snapshot taken
+            # before their walk reads the tree (DecisionCache.put), the
+            # pre+post bumps guarantee no evaluation that saw the OLD tree
+            # can store an entry whose epoch survives: its snapshot
+            # predates at least the post-swap bump
             decision_cache.bump_epoch()
         self.engine.replace_policy_sets(tree)
         if self.evaluator is not None:

@@ -1210,6 +1210,20 @@ def main():
         merged[backend_row["metric"]] = backend_row
     for row in rows:
         merged[row["metric"]] = row
+    # bench.py keeps rc=0 on TPU-probe failure (the driver needs a valid
+    # headline row), so the evidence matrix is where accelerator loss must
+    # become loud: any row annotated tpu_error is a CPU-fallback number
+    # and must never be read as a TPU result
+    fallback = sorted(
+        r["metric"] for r in merged.values() if r.get("tpu_error")
+    )
+    if fallback:
+        print(
+            "WARNING: CPU-fallback rows carry tpu_error (accelerator was "
+            "unavailable; numbers are NOT TPU results): "
+            + ", ".join(fallback),
+            file=sys.stderr, flush=True,
+        )
     with open(path, "w") as fh:
         json.dump(list(merged.values()), fh, indent=1)
 

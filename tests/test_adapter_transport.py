@@ -11,7 +11,10 @@ from types import SimpleNamespace
 
 import pytest
 
-from access_control_srv_tpu.core.errors import UnexpectedContextQueryResponse
+from access_control_srv_tpu.core.errors import (
+    ContextQueryTransportError,
+    UnexpectedContextQueryResponse,
+)
 from access_control_srv_tpu.models import Request, Target
 from access_control_srv_tpu.srv.adapters import GraphQLAdapter, create_adapter
 from access_control_srv_tpu.srv.cache import HRScopeProvider, SubjectCache
@@ -129,6 +132,56 @@ def test_configurable_timeout_bounds_slow_endpoint(gql_server):
             adapter.query(context_query(), request())
         # far below the old hard-coded 30s urlopen timeout
         assert time.perf_counter() - t0 < 2.0
+    finally:
+        adapter.close()
+
+
+def test_non_2xx_raises_clean_transport_error():
+    """An upstream error (often an HTML body) must surface as a transport
+    error carrying the HTTP status — the old urlopen raised HTTPError here
+    — never reach GraphQL JSON parsing."""
+    class _ErrorHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = b"<html><body>502 Bad Gateway</body></html>"
+            self.send_response(502)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _ErrorHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/graphql"
+    adapter = GraphQLAdapter(url)
+    try:
+        with pytest.raises(ContextQueryTransportError) as exc_info:
+            adapter.query(context_query(), request())
+        # the engine's deny-on-error branch reads .code for the
+        # operation status, preserving the upstream classification
+        assert exc_info.value.code == 502
+    finally:
+        adapter.close()
+        server.shutdown()
+        server.server_close()
+
+
+def test_pool_follows_url_argument(gql_server):
+    url, _ = gql_server
+    adapter = GraphQLAdapter(url)
+    try:
+        assert adapter.query(context_query(), request()) == [{"id": "res-1"}]
+        # repoint the adapter at a dead endpoint: the pool must rekey on
+        # the url instead of silently posting to the original host
+        adapter.url = "http://127.0.0.1:1/graphql"
+        with pytest.raises(OSError):
+            adapter.query(context_query(), request())
     finally:
         adapter.close()
 

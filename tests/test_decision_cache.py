@@ -117,6 +117,20 @@ class TestDecisionCacheUnit:
         stats = cache.stats()
         assert stats["entries"] == 0 and stats["epoch"] == epoch + 1
 
+    def test_put_refuses_stale_epoch_snapshot(self):
+        # a decision whose evaluation spans an epoch bump (CRUD/restore
+        # completing mid-walk) must never be stored as fresh: the writer's
+        # lookup-time snapshot, not the epoch at write time, stamps it
+        cache = DecisionCache()
+        epoch = cache.epoch  # snapshot at lookup/miss time
+        cache.bump_epoch()   # tree mutation lands while computing
+        assert not cache.put("u\x1fk", permit_response(), epoch=epoch)
+        assert cache.get("u\x1fk") is None
+        assert cache.stats()["entries"] == 0
+        # a snapshot matching the current epoch stores normally
+        assert cache.put("u\x1fk", permit_response(), epoch=cache.epoch)
+        assert cache.get("u\x1fk") is not None
+
     def test_put_gates_on_cacheable_and_status(self):
         cache = DecisionCache()
         uncacheable = permit_response()
@@ -302,6 +316,30 @@ class TestWorkerCachePath:
         assert out["flushed"]["decisions"] >= 1
         assert worker.decision_cache.stats()["entries"] == 0
 
+    def test_flush_cache_string_db_index_coerced(self, worker):
+        # loosely-typed JSON payloads send "5": the command must coerce
+        # and flush instead of silently flushing nothing with status ok
+        worker.service.is_allowed(admin_request())
+        assert worker.decision_cache.stats()["entries"] >= 1
+        out = worker.command_interface.command(
+            "flush_cache", {"data": {"db_index": "5"}}
+        )
+        assert out["flushed"]["decisions"] >= 1
+        assert worker.decision_cache.stats()["entries"] == 0
+
+    def test_flush_cache_unrecognized_db_index_errors(self, worker):
+        worker.service.is_allowed(admin_request())
+        entries = worker.decision_cache.stats()["entries"]
+        out = worker.command_interface.command(
+            "flush_cache", {"data": {"db_index": 7}}
+        )
+        assert "error" in out
+        assert worker.decision_cache.stats()["entries"] == entries
+        out = worker.command_interface.command(
+            "flush_cache", {"data": {"db_index": "not-a-db"}}
+        )
+        assert "error" in out
+
     def test_flush_cache_pattern_narrows_to_subject(self, worker):
         install_reader_tree(worker)
         worker.service.is_allowed(admin_request())  # subject "root"
@@ -314,6 +352,51 @@ class TestWorkerCachePath:
         hits = worker.decision_cache.stats()["hits"]
         worker.service.is_allowed(admin_request())
         assert worker.decision_cache.stats()["hits"] == hits + 1
+
+    def test_decision_spanning_epoch_bump_is_not_cached(self, worker,
+                                                        monkeypatch):
+        """The CRUD-during-evaluation interleaving: a decision computed
+        against the old tree that completes after the epoch bump must not
+        be served as fresh for a TTL."""
+        install_reader_tree(worker)
+        evaluator = worker.service.evaluator
+        cache = worker.decision_cache
+        real = evaluator._oracle_is_allowed
+
+        def bump_mid_flight(request):
+            response = real(request)
+            cache.bump_epoch()  # CRUD/restore completes while in flight
+            return response
+
+        monkeypatch.setattr(evaluator, "_oracle_is_allowed", bump_mid_flight)
+        stores = cache.stats()["stores"]
+        assert evaluator.is_allowed(reader_request()).decision == \
+            Decision.PERMIT
+        # the write-through was refused: its epoch snapshot predates the
+        # bump, so nothing stale entered the cache
+        assert cache.stats()["stores"] == stores
+        assert cache.stats()["entries"] == 0
+
+    def test_batch_spanning_epoch_bump_is_not_cached(self, worker,
+                                                     monkeypatch):
+        install_reader_tree(worker)
+        evaluator = worker.service.evaluator
+        cache = worker.decision_cache
+        real = evaluator._is_allowed_batch_uncached
+
+        def bump_mid_flight(requests):
+            responses = real(requests)
+            cache.bump_epoch()
+            return responses
+
+        monkeypatch.setattr(
+            evaluator, "_is_allowed_batch_uncached", bump_mid_flight
+        )
+        responses = evaluator.is_allowed_batch(
+            [reader_request(), admin_request()]
+        )
+        assert all(r.decision == Decision.PERMIT for r in responses)
+        assert cache.stats()["entries"] == 0
 
     def test_config_update_bumps_epoch(self, worker):
         epoch = worker.decision_cache.stats()["epoch"]
