@@ -270,6 +270,13 @@ class NativeBatchEncoder:
                     rgx_set[:, e] = set_col
                     pfx_neq[:, e] = neq_col
 
+        # stage-B owner bitplanes: the C++ core emits the raw wire-shaped
+        # arrays; the packed owner-bit columns are deferred to the shared
+        # Python packer (a pure vectorized-numpy function of those arrays),
+        # so the native and Python encode paths are bit-identical by
+        # construction
+        a.update(_pyenc.pack_owner_bitplanes(a, self.compiled))
+
         C = len(self.compiled.conditions)  # always 0 (ctor guard)
         return RequestBatch(
             B=B,

@@ -333,12 +333,14 @@ def compile_policies(
 
     arrays = dict(a)
     arrays.update(table.to_arrays())
-    # (role, scoping) vocabulary for stage B: the owner-membership sweeps
-    # are factored per distinct (t_role, t_scoping) pair — typically far
-    # fewer than T — and gathered back per target row (kernel
-    # _match_targets owner_checks).  The vocab arrays are global
-    # (group-invariant under prefilter compaction); t_rs_idx is a regular
-    # target-table column so row subsets keep it aligned.
+    # (role, scoping) vocabulary for stage B: the owner-membership
+    # verdicts are factored per distinct (t_role, t_scoping) pair —
+    # typically far fewer than T — computed host-side at encode
+    # (ops/encode.pack_owner_bitplanes) and gathered back per target row
+    # through the packed bitplanes (kernel _hr_pass_from_bits).  The
+    # vocab arrays are global (group-invariant under prefilter
+    # compaction) and host-only; t_rs_idx is a regular target-table
+    # column so row subsets keep it aligned.
     rs_pairs = np.stack(
         [arrays["t_role"], arrays["t_scoping"]], axis=1
     )

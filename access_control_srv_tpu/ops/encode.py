@@ -344,12 +344,204 @@ def alloc_row_arrays(B: int, caps: dict[str, int] | None = None
     }
 
 
+def owner_bit_layout(rv: int, nru: int, nop: int) -> tuple[int, int, int, int]:
+    """Packed owner-bitplane layout, shared by the host packer below and
+    the kernel's unpack (ops/kernel._owner_bit_reader).
+
+    Per (request row, role-scope-vocab entry) the packed verdicts are
+    ``ebits = 2*nru + 2*nop`` bits, in order:
+
+      [0, nru)            A: instance-group g fails when its run is
+                          collected AND the target row has hr_check
+                          (direct OR hierarchical owner match required)
+      [nru, 2*nru)        B: same with hr_check disabled (direct only)
+      [2*nru, 2*nru+nop)  opA: operation slot j fails when op-hit, with
+                          hr_check
+      [.., 2*nru+2*nop)   opB: same with hr_check disabled
+
+    Returns (ebits, epw, wpe, nwords): when ebits <= 32 entries pack
+    ``epw = 32 // ebits`` per int32 word (entry v -> word v // epw, bit
+    offset (v % epw) * ebits) and ``wpe`` is 1; wider entries (ceiling
+    caps) span ``wpe = ceil(ebits / 32)`` words each (bit k of entry v ->
+    word v * wpe + k // 32, offset k % 32) and ``epw`` is 0."""
+    ebits = 2 * (nru + nop)
+    if ebits <= 32:
+        epw = 32 // ebits
+        return ebits, epw, 1, -(-rv // epw)
+    wpe = -(-ebits // 32)
+    return ebits, 0, wpe, rv * wpe
+
+
+def owner_bits_needed(compiled: CompiledPolicies) -> bool:
+    """Stage B runs only when some target row carries BOTH subjects and a
+    scoping entity (mirrors ops/kernel.tree_needs_hr without importing the
+    kernel module — kernel imports this one)."""
+    a = compiled.arrays
+    return bool(
+        (np.asarray(a["t_has_scoping"]) & (np.asarray(a["t_n_subjects"]) > 0)).any()
+    )
+
+
+def _owner_verdicts(hrv_role, hrv_scope, ra3, ra2, hr, own_ent, own_inst):
+    """Vectorized owner pair checks against role associations / HR closure
+    at (row, role-scope-vocab entry, owner-bearing slot) granularity —
+    the host-side replacement for the kernel's former stage-B device
+    matmuls, identical semantics (reference: hierarchicalScope.ts:165-245).
+    Counts stay exact in f32 (NRA/NHR < 2^24).  Returns (direct, hier)
+    bool [b, RV, N]."""
+    b, N, NOWN = own_ent.shape
+    rv = hrv_role.shape[0]
+    qe = own_ent.reshape(b, N * NOWN)
+    qi = own_inst.reshape(b, N * NOWN)
+    ent_m = (qe[:, None, :] == hrv_scope[None, :, None]) & (qe >= 0)[:, None, :]
+    # direct: (role, scoping, owner-instance) in ra3
+    ra3_valid = ra3[:, :, 1] >= 0
+    rs3 = (
+        (ra3[:, :, 0][:, :, None] == hrv_role[None, None, :])
+        & (ra3[:, :, 1][:, :, None] == hrv_scope[None, None, :])
+        & ra3_valid[:, :, None]
+    )  # [b, NRA, RV]
+    i3 = ra3[:, :, 2][:, :, None] == qi[:, None, :]  # [b, NRA, Q]
+    dcnt = np.matmul(
+        rs3.transpose(0, 2, 1).astype(np.float32), i3.astype(np.float32)
+    )  # [b, RV, Q]
+    direct = ent_m & (dcnt > 0)
+    # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
+    ra2_valid = ra2[:, :, 1] >= 0
+    ra2_ok = (
+        (ra2[:, :, 0][:, :, None] == hrv_role[None, None, :])
+        & (ra2[:, :, 1][:, :, None] == hrv_scope[None, None, :])
+        & ra2_valid[:, :, None]
+    ).any(axis=1)  # [b, RV]
+    hr_valid = hr[:, :, 1] >= 0
+    rh = (
+        hr[:, :, 0][:, :, None] == hrv_role[None, None, :]
+    ) & hr_valid[:, :, None]  # [b, NHR, RV]
+    ih = hr[:, :, 1][:, :, None] == qi[:, None, :]  # [b, NHR, Q]
+    hcnt = np.matmul(
+        rh.transpose(0, 2, 1).astype(np.float32), ih.astype(np.float32)
+    )  # [b, RV, Q]
+    hier = ent_m & (hcnt > 0) & ra2_ok[:, :, None]
+    return (
+        direct.reshape(b, rv, N, NOWN).any(axis=3),
+        hier.reshape(b, rv, N, NOWN).any(axis=3),
+    )
+
+
+def pack_owner_bitplanes(
+    arrays: dict[str, np.ndarray],
+    compiled: CompiledPolicies,
+    skip: bool = False,
+) -> dict[str, np.ndarray]:
+    """Host-precomputed stage-B owner verdicts, packed per
+    ``owner_bit_layout``:
+
+      r_own_runs [B, NRU] — the distinct instance-bearing entity runs per
+          row (ABSENT-padded); bit group g of every vocab entry refers to
+          run r_own_runs[g].
+      r_own_bits [B, NWORDS] — the packed A/B/opA/opB fail bits per
+          (row, vocab entry).
+
+    Pure function of the raw encoder arrays, so BOTH encoders share it:
+    the Python encoder calls it inline and the native (C++) wire encoder
+    defers to it after filling the raw arrays (native/__init__.py) —
+    bit-identity between the two paths is then structural.  ``skip=True``
+    (or a tree without HR-bearing targets) emits 1-wide dummies that
+    stage-B-free kernels never read."""
+    B = arrays["r_ent_vals"].shape[0]
+    if skip or not owner_bits_needed(compiled):
+        return {
+            "r_own_runs": np.full((B, 1), ABSENT, np.int32),
+            "r_own_bits": np.zeros((B, 1), np.int32),
+        }
+    hrv_role = np.asarray(compiled.arrays["hrv_role"])
+    hrv_scope = np.asarray(compiled.arrays["hrv_scope"])
+    RV = hrv_role.shape[0]
+    NOP = arrays["r_op_vals"].shape[1]
+
+    inst_run = arrays["r_inst_run"]
+    valid_i = arrays["r_inst_valid"] & (inst_run >= 0)  # [B, NI]
+    # distinct instance-bearing runs per row, power-of-two bucketed so the
+    # compiled kernel shapes stay bounded (almost always 1)
+    big = np.int32(1 << 30)
+    runs_sorted = np.sort(np.where(valid_i, inst_run, big), axis=1)
+    fresh = np.ones(runs_sorted.shape, bool)
+    fresh[:, 1:] = runs_sorted[:, 1:] != runs_sorted[:, :-1]
+    fresh &= runs_sorted < big
+    counts = fresh.sum(axis=1)
+    nru = _pow2_at_least(int(counts.max()) if B else 1, 1)
+    own_runs = np.full((B, nru), ABSENT, np.int32)
+    b_idx, j_idx = np.nonzero(fresh)
+    pos = (np.cumsum(fresh, axis=1) - 1)[b_idx, j_idx]
+    own_runs[b_idx, pos] = runs_sorted[b_idx, j_idx]
+
+    ebits, epw, wpe, nwords = owner_bit_layout(RV, nru, NOP)
+    words = np.zeros((B, nwords), np.uint32)
+    if B:
+        # chunk the batch so the [b, RV, NHR]-scale broadcasts stay within
+        # a fixed working-set budget even for deep-HR ceiling caps
+        NHR = max(arrays["r_hr"].shape[1], 1)
+        per_row = RV * max(NHR, arrays["r_inst_owner_ent"].shape[1] * 8) * 4
+        chunk = max(64, min(B, (64 << 20) // max(per_row, 1)))
+        miss_i = ~(arrays["r_inst_present"] & arrays["r_inst_has_owners"])
+        op_valid = arrays["r_op_vals"] >= 0
+        op_miss = ~(arrays["r_op_present"] & arrays["r_op_has_owners"])
+        g_one = (
+            inst_run[:, :, None] == own_runs[:, None, :]
+        ) & valid_i[:, :, None]  # [B, NI, NRU]
+        # within-word bit offsets / word index per flat (entry, bit) —
+        # monotone in flat order, so packing reduces with one reduceat
+        flat = np.arange(RV * ebits)
+        v_of, k_of = flat // ebits, flat % ebits
+        if epw:
+            w_of = v_of // epw
+            off = ((v_of % epw) * ebits + k_of).astype(np.uint64)
+        else:
+            w_of = v_of * wpe + k_of // 32
+            off = (k_of % 32).astype(np.uint64)
+        starts = np.nonzero(np.diff(w_of, prepend=-1))[0]
+        for lo in range(0, B, chunk):
+            hi = min(B, lo + chunk)
+            sl = slice(lo, hi)
+            dir_i, hier_i = _owner_verdicts(
+                hrv_role, hrv_scope, arrays["r_ra3"][sl], arrays["r_ra2"][sl],
+                arrays["r_hr"][sl], arrays["r_inst_owner_ent"][sl],
+                arrays["r_inst_owner_inst"][sl],
+            )  # [b, RV, NI]
+            dir_o, hier_o = _owner_verdicts(
+                hrv_role, hrv_scope, arrays["r_ra3"][sl], arrays["r_ra2"][sl],
+                arrays["r_hr"][sl], arrays["r_op_owner_ent"][sl],
+                arrays["r_op_owner_inst"][sl],
+            )  # [b, RV, NOP]
+            bad_a = valid_i[sl][:, None, :] & (
+                miss_i[sl][:, None, :] | ~(dir_i | hier_i)
+            )
+            bad_b = valid_i[sl][:, None, :] & (miss_i[sl][:, None, :] | ~dir_i)
+            g1 = g_one[sl].astype(np.float32)
+            a_run = np.matmul(bad_a.astype(np.float32), g1) > 0  # [b, RV, NRU]
+            b_run = np.matmul(bad_b.astype(np.float32), g1) > 0
+            op_a = op_valid[sl][:, None, :] & (
+                op_miss[sl][:, None, :] | ~(dir_o | hier_o)
+            )
+            op_b = op_valid[sl][:, None, :] & (op_miss[sl][:, None, :] | ~dir_o)
+            bits3 = np.concatenate([a_run, b_run, op_a, op_b], axis=2)
+            contrib = bits3.reshape(hi - lo, RV * ebits).astype(np.uint64) << off
+            words[sl] = np.add.reduceat(contrib, starts, axis=1).astype(
+                np.uint32
+            )
+    return {
+        "r_own_runs": own_runs,
+        "r_own_bits": np.ascontiguousarray(words).view(np.int32),
+    }
+
+
 def encode_requests(
     requests: list[Request],
     compiled: CompiledPolicies,
     resource_adapter=None,
     skip_conditions: bool = False,
     caps: dict[str, int] | None = None,
+    skip_owner_bits: bool = False,
 ) -> RequestBatch:
     """``skip_conditions=True`` skips the host-assisted condition pre-pass
     (and its adapter-driven batch degradation): whatIsAllowed never
@@ -753,6 +945,11 @@ def encode_requests(
                 # operation_status.message (accessController.ts:259-270);
                 # cached here so abort rows need no oracle re-run
                 cond_msg[(ci, b)] = str(err) or "Unknown Error!"
+
+    # host-precomputed stage-B owner bitplanes: the kernels consume these
+    # packed verdicts instead of the raw ra3/ra2/hr/owner-pair arrays
+    # (which stay allocated for the ACL stage and the native ABI)
+    a.update(pack_owner_bitplanes(a, compiled, skip=skip_owner_bits))
 
     return RequestBatch(
         B=B,

@@ -318,60 +318,62 @@ def _acl_pass(c: dict, r: dict, with_acl: bool):
     return skip | (short == 1) | ((short == 0) & pair_ok)
 
 
-def _make_owner_checks(rv_role, rv_scope, r: dict):
-    """Owner pair checks against role associations / HR closure at
-    (role, scoping)-vocab granularity (reference:
-    hierarchicalScope.ts:165-245).  Returns a closure mapping owner
-    (entity, instance) pair arrays [N, NOWN] to (direct_v, hier_v)
-    [RV, N]; callers gather to their own granularity (target rows in the
-    dense kernel, rule/policy planes in the signature kernel).  The NHR
-    membership conjunction runs as a boolean matmul (f32 accumulate,
-    exact for counts < 2^24) that lands on the MXU."""
-    ra3 = r["r_ra3"]  # [NRA, 3]
-    ra3_valid = ra3[:, 1] >= 0
-    rs_hit3 = (
-        (rv_role[:, None] == ra3[None, :, 0])
-        & (rv_scope[:, None] == ra3[None, :, 1])
-        & ra3_valid[None, :]
-    )  # [RV, NRA]
-    ra2 = r["r_ra2"]
-    ra2_valid = ra2[:, 1] >= 0
-    ra2_ok_v = (
-        (rv_role[:, None] == ra2[None, :, 0])
-        & (rv_scope[:, None] == ra2[None, :, 1])
-        & ra2_valid[None, :]
-    ).any(axis=1)  # [RV]
-    hr = r["r_hr"]
-    hr_valid = hr[:, 1] >= 0
-    role_hit = (rv_role[:, None] == hr[None, :, 0]) & hr_valid[None, :]
+def _owner_bit_reader(bits, v, ebits: int):
+    """Unpack accessor over the host-packed owner bitplanes
+    (ops/encode.owner_bit_layout): ``bits`` is one request's packed word
+    vector [NWORDS], ``v`` an int array of role-scope-vocab indices (any
+    shape — target rows in the dense kernel, rule/policy planes in the
+    signature kernel).  Returns ``bit(k) -> bool array shaped like v``.
+    Arithmetic >> on int32 is safe here: the payload bit is extracted
+    with & 1 after the shift."""
+    if ebits <= 32:
+        epw = 32 // ebits
+        codes = jnp.take(bits, v // epw) >> ((v % epw) * ebits)
 
-    def owner_checks(owner_ent, owner_inst):
-        # owner_ent/owner_inst: [N, NOWN]; returns direct/hier [RV, N]
-        N, NOWN = owner_inst.shape
-        q_ent = owner_ent.reshape(-1)    # [Q = N*NOWN]
-        q_inst = owner_inst.reshape(-1)
-        ent_match_v = (
-            rv_scope[:, None] == q_ent[None, :]
-        ) & (q_ent >= 0)[None, :]  # [RV, Q]
-        # direct: (role, scoping, owner-instance) in ra3
-        inst_hit3 = q_inst[:, None] == ra3[None, :, 2]  # [Q, NRA]
-        direct_cnt = jnp.matmul(
-            rs_hit3.astype(jnp.float32),
-            inst_hit3.astype(jnp.float32).T,
-        )  # [RV, Q]
-        direct_v = ent_match_v & (direct_cnt > 0)
-        # hierarchical: (role, scoping) in ra2 and (role, owner-inst) in hr
-        inst_hit = q_inst[:, None] == hr[None, :, 1]  # [Q, NHR]
-        hier_cnt = jnp.matmul(
-            role_hit.astype(jnp.float32),
-            inst_hit.astype(jnp.float32).T,
-        )  # [RV, Q]
-        hier_v = ent_match_v & (hier_cnt > 0) & ra2_ok_v[:, None]
-        direct = direct_v.reshape(-1, N, NOWN).any(axis=2)  # [RV, N]
-        hier = hier_v.reshape(-1, N, NOWN).any(axis=2)
-        return direct, hier
+        def bit(k: int):
+            return ((codes >> k) & 1) == 1
 
-    return owner_checks
+        return bit
+    wpe = -(-ebits // 32)
+    base = v * wpe
+
+    def bit(k: int):
+        return ((jnp.take(bits, base + k // 32) >> (k % 32)) & 1) == 1
+
+    return bit
+
+
+def _hr_pass_from_bits(r: dict, v, collect, op_hit, hr_check, trivial):
+    """Stage B from host-precomputed owner bitplanes: combines the packed
+    per-(row, vocab) fail verdicts with the signature/target-determined
+    collection state and operation hits (reference:
+    hierarchicalScope.ts:54-258 — the owner-membership side was folded
+    host-side at encode, ops/encode.pack_owner_bitplanes).
+
+    ``v``/``hr_check``/``trivial`` share a leading shape ([T] dense,
+    [S, M] / [S, KP] signature planes); ``collect``/``op_hit`` carry one
+    trailing run/op-slot axis.  All device work is elementwise + one tiny
+    int gather per plane — no matmuls, no [RV, ...] intermediates."""
+    runs = r["r_own_runs"]  # [NRU]
+    nru = int(runs.shape[0])
+    nop = int(op_hit.shape[-1])
+    bit = _owner_bit_reader(r["r_own_bits"], v, 2 * (nru + nop))
+    bad = jnp.zeros(v.shape, bool)
+    n_runs = int(collect.shape[-1])
+    for g in range(nru):
+        # collect at group g's run: a static select over the run axis, not
+        # a gather (post-reduction gathers are the TPU slow path)
+        coll_g = jnp.zeros(v.shape, bool)
+        for nr in range(n_runs):
+            coll_g = coll_g | ((runs[g] == nr) & collect[..., nr])
+        bad = bad | (coll_g & jnp.where(hr_check, bit(g), bit(nru + g)))
+    for j in range(nop):
+        bad = bad | (
+            op_hit[..., j]
+            & jnp.where(hr_check, bit(2 * nru + j), bit(2 * nru + nop + j))
+        )
+    ctx_ok = r["r_ctx_present"] & (r["r_n_ra"] > 0)
+    return trivial | (ctx_ok & ~bad)
 
 
 def _hr_collect_state(c: dict, r: dict, rgx_hit, pfx_neq, ent_valid):
@@ -627,55 +629,14 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     # collection per (target, entity slot, run) with sticky state like the
     # reference HR loop (exact OR regex sets, prefix mismatch resets,
     # reference: hierarchicalScope.ts:61-124) — shared with the signature
-    # planes builder
+    # planes builder.  The owner-membership side arrives as host-packed
+    # bitplanes indexed by the (role, scoping) vocab (compile.py hrv_*,
+    # encode.pack_owner_bitplanes), gathered per target row via t_rs_idx.
     collect, op_hit = _hr_collect_state(c, r, rgx_hit, pfx_neq, ent_valid)
-
-    inst_valid = r["r_inst_valid"]  # [NI]
-    inst_run = jnp.clip(r["r_inst_run"], 0, None)
-    need_inst = jnp.take(collect, inst_run, axis=1) & inst_valid[None, :] & (
-        r["r_inst_run"] >= 0
-    )[None, :]  # [T, NI]
-    inst_missing = need_inst & (
-        ~r["r_inst_present"] | ~r["r_inst_has_owners"]
-    )[None, :]
-
-    # owner pair checks against role associations / HR closure, factored
-    # per distinct (role, scoping) vocab pair (compile.py hrv_*): the
-    # membership sweeps over ra3/hr run at [RV, ...] instead of
-    # [T, ...], the NHR sweep becomes ONE boolean matmul on the MXU, and
-    # the results gather back per target row via t_rs_idx.  Semantics are
-    # unchanged from the direct broadcast (reference:
-    # hierarchicalScope.ts:165-245).
-    t_rs = c["t_rs_idx"]  # [T]
-    owner_v = _make_owner_checks(c["hrv_role"], c["hrv_scope"], r)
-
-    def owner_checks(owner_ent, owner_inst):
-        direct_v, hier_v = owner_v(owner_ent, owner_inst)
-        return jnp.take(direct_v, t_rs, axis=0), jnp.take(hier_v, t_rs, axis=0)
-
-    inst_direct, inst_hier = owner_checks(
-        r["r_inst_owner_ent"], r["r_inst_owner_inst"]
-    )
-    inst_ok = inst_direct | (c["t_hr_check"][:, None] & inst_hier)
-    inst_bad = need_inst & ~inst_ok
-
-    # operation-resource branch (reference: hierarchicalScope.ts:126-147)
-    op_missing = op_hit & (~r["r_op_present"] | ~r["r_op_has_owners"])[None, :]
-    op_direct, op_hier = owner_checks(r["r_op_owner_ent"], r["r_op_owner_inst"])
-    op_ok = op_direct | (c["t_hr_check"][:, None] & op_hier)
-    op_bad = op_hit & ~op_ok
-
     hr_trivial = (c["t_n_subjects"] == 0) | ~c["t_has_scoping"]
-    hr_pass = hr_trivial | (
-        r["r_ctx_present"]
-        & (r["r_n_ra"] > 0)
-        & ~inst_missing.any(axis=1)
-        & ~inst_bad.any(axis=1)
-        & ~op_missing.any(axis=1)
-        & ~op_bad.any(axis=1)
+    out["hr_pass"] = _hr_pass_from_bits(
+        r, c["t_rs_idx"], collect, op_hit, c["t_hr_check"], hr_trivial
     )
-
-    out["hr_pass"] = hr_pass
     return out
 
 
@@ -1051,7 +1012,12 @@ class DecisionKernel:
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
             )
         self.compiled = compiled
-        self._c = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}
+        # hrv_role/hrv_scope stay host-side (encode's owner-bit packer
+        # consumes them; the device programs read only packed bitplanes)
+        self._c = {
+            k: jnp.asarray(v) for k, v in compiled.arrays.items()
+            if k not in ("hrv_role", "hrv_scope")
+        }
         self._bake_constants = bake_policy_constants(compiled)
         with_hr = tree_needs_hr(compiled.arrays)
 

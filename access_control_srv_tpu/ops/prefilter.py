@@ -49,7 +49,7 @@ from .kernel import (
     _action_kind,
     _combine_and_decide_flat,
     _evaluate_one,
-    _make_owner_checks,
+    _hr_pass_from_bits,
     _match_targets,
     _multi_entity_ok,
     _policy_gates_core,
@@ -72,20 +72,14 @@ _SIG_R_KEYS = [
     "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
     "r_n_entity_attrs", "r_n_ra", "r_acl_short",
 ]
-# additional per-row arrays when the tree carries HR-bearing targets
-# (stage B's owner side is per-request; its collection state is
-# per-signature)
-_SIG_R_KEYS_HR = _SIG_R_KEYS + [
-    "r_inst_run", "r_inst_valid", "r_inst_present", "r_inst_has_owners",
-    "r_inst_owner_ent", "r_inst_owner_inst",
-    "r_op_present", "r_op_has_owners", "r_op_owner_ent", "r_op_owner_inst",
-    "r_ra3", "r_ra2", "r_hr", "r_ctx_present",
-]
+# additional per-row arrays when the tree carries HR-bearing targets:
+# stage B's owner side travels as host-packed bitplanes (two narrow int32
+# columns instead of the former ra3/ra2/hr/owner-pair arrays — ~5x less
+# per-row transfer on the stress-hr shape); its collection state stays
+# per-signature
+_SIG_R_KEYS_HR = _SIG_R_KEYS + ["r_ctx_present", "r_own_runs", "r_own_bits"]
 # int32-packed columns that are semantically bool
-_SIG_BOOL_KEYS = {
-    "r_inst_valid", "r_inst_present", "r_inst_has_owners",
-    "r_op_present", "r_op_has_owners", "r_ctx_present",
-}
+_SIG_BOOL_KEYS = {"r_ctx_present"}
 
 _RULE_FIELDS = [
     "rule_valid", "rule_effect", "rule_cacheable_raw", "rule_cacheable_eff",
@@ -263,9 +257,11 @@ class PrefilteredKernel:
                 self._dense = ShardedDecisionKernel(compiled, mesh, axis)
             else:
                 self._dense = DecisionKernel(compiled)
+        # hrv_role/hrv_scope are host-only since the owner-bitplane
+        # rewrite (consumed by encode's packer, never by a device program)
         self._c_inv = {
             k: jnp.asarray(v) for k, v in compiled.arrays.items()
-            if not _is_varying(k)
+            if not _is_varying(k) and k not in ("hrv_role", "hrv_scope")
         }
 
     def _runner(self, with_acl: bool, with_hr: bool):
@@ -437,126 +433,20 @@ class PrefilteredKernel:
                     if with_hr:
                         # stage B at plane granularity: collection state
                         # and op hits are per-signature (sg planes); the
-                        # owner side is per-request via the shared vocab
-                        # owner checks (reference:
-                        # hierarchicalScope.ts:10-258)
-                        owner_v = _make_owner_checks(
-                            c["hrv_role"], c["hrv_scope"], rr
-                        )
-                        i_dir, i_hier = owner_v(
-                            rr["r_inst_owner_ent"], rr["r_inst_owner_inst"]
-                        )  # [RV, NI]
-                        o_dir, o_hier = owner_v(
-                            rr["r_op_owner_ent"], rr["r_op_owner_inst"]
-                        )  # [RV, NOP]
-                        ctx_ok = (
-                            rr["r_ctx_present"] & (rr["r_n_ra"] > 0)
-                        )
-                        run_idx = jnp.clip(rr["r_inst_run"], 0, None)
-                        need_base = rr["r_inst_valid"] & (
-                            rr["r_inst_run"] >= 0
-                        )  # [NI]
-                        miss_base = (
-                            ~rr["r_inst_present"]
-                            | ~rr["r_inst_has_owners"]
-                        )
-                        op_miss_base = (
-                            ~rr["r_op_present"] | ~rr["r_op_has_owners"]
-                        )
-                        NI = int(run_idx.shape[0])
-                        NOPc = int(op_miss_base.shape[0])
-                        packable = 2 * (NI + NOPc) <= 31
-
-                        if packable:
-                            # pack the per-(vocab, slot) owner verdicts
-                            # into one int32 per vocab row: the four
-                            # [.., NI]-wide plane gathers collapse to ONE
-                            # int gather + shift unpacks (gathers are the
-                            # slow path on TPU; see TPU_COMPAT.md)
-                            code = jnp.zeros(i_dir.shape[0], jnp.int32)
-                            for i in range(NI):
-                                code = code | (
-                                    i_dir[:, i].astype(jnp.int32) << i
-                                ) | (
-                                    i_hier[:, i].astype(jnp.int32)
-                                    << (NI + i)
-                                )
-                            for j in range(NOPc):
-                                code = code | (
-                                    o_dir[:, j].astype(jnp.int32)
-                                    << (2 * NI + j)
-                                ) | (
-                                    o_hier[:, j].astype(jnp.int32)
-                                    << (2 * NI + NOPc + j)
-                                )
-
-                        def hr_level(collect_p, op_hit_p, triv_p, rs_p,
-                                     hrchk_p):
-                            if not packable:
-                                need = jnp.take(
-                                    collect_p, run_idx, axis=-1
-                                ) & need_base
-                                inst_ok = jnp.take(i_dir, rs_p, axis=0) | (
-                                    hrchk_p[..., None]
-                                    & jnp.take(i_hier, rs_p, axis=0)
-                                )
-                                op_ok = jnp.take(o_dir, rs_p, axis=0) | (
-                                    hrchk_p[..., None]
-                                    & jnp.take(o_hier, rs_p, axis=0)
-                                )
-                                bad = (
-                                    (need & miss_base).any(-1)
-                                    | (need & ~inst_ok).any(-1)
-                                    | (op_hit_p & op_miss_base).any(-1)
-                                    | (op_hit_p & ~op_ok).any(-1)
-                                )
-                                return triv_p | (ctx_ok & ~bad)
-                            codes = jnp.take(code, rs_p, axis=0)
-                            bad = jnp.zeros(rs_p.shape, bool)
-                            NR_runs = collect_p.shape[-1]
-                            for i in range(NI):
-                                # collect at this instance's run: a
-                                # static select over NR, not a gather
-                                coll_i = jnp.zeros(rs_p.shape, bool)
-                                for nr in range(NR_runs):
-                                    coll_i = coll_i | (
-                                        (run_idx[i] == nr)
-                                        & collect_p[..., nr]
-                                    )
-                                need_i = coll_i & need_base[i]
-                                dir_i = (((codes >> i) & 1) == 1)
-                                hier_i = (
-                                    ((codes >> (NI + i)) & 1) == 1
-                                )
-                                ok_i = dir_i | (hrchk_p & hier_i)
-                                bad = bad | (
-                                    need_i & (miss_base[i] | ~ok_i)
-                                )
-                            for j in range(NOPc):
-                                dir_j = (
-                                    ((codes >> (2 * NI + j)) & 1) == 1
-                                )
-                                hier_j = (
-                                    ((codes >> (2 * NI + NOPc + j)) & 1)
-                                    == 1
-                                )
-                                ok_j = dir_j | (hrchk_p & hier_j)
-                                bad = bad | (
-                                    op_hit_p[..., j]
-                                    & (op_miss_base[j] | ~ok_j)
-                                )
-                            return triv_p | (ctx_ok & ~bad)
-
+                        # owner side arrives as host-packed bitplanes
+                        # (encode.pack_owner_bitplanes) — one tiny int
+                        # gather + shift unpacks per plane, no matmuls
+                        # (reference: hierarchicalScope.ts:10-258)
                         M_ = KP_ * KR_
-                        hr_rule = hr_level(
+                        hr_rule = _hr_pass_from_bits(
+                            rr, flat(sg["rl_rs"]),
                             sg["rl_collect"].reshape(S_, M_, -1),
                             sg["rl_op_hit"].reshape(S_, M_, -1),
-                            flat(sg["rl_triv"]), flat(sg["rl_rs"]),
-                            flat(sg["rl_hrchk"]),
+                            flat(sg["rl_hrchk"]), flat(sg["rl_triv"]),
                         )  # [S, M]
-                        hr_pol = hr_level(
-                            sg["pl_collect"], sg["pl_op_hit"],
-                            sg["pl_triv"], sg["pl_rs"], sg["pl_hrchk"],
+                        hr_pol = _hr_pass_from_bits(
+                            rr, sg["pl_rs"], sg["pl_collect"],
+                            sg["pl_op_hit"], sg["pl_hrchk"], sg["pl_triv"],
                         )  # [S, KP]
                         reached = reached & (~rht_f | hr_rule)
                         pol_subject = (

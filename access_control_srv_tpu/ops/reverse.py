@@ -450,8 +450,10 @@ def what_is_allowed_batch(
     the kernel's version-pinned tree snapshot; ineligible rows fall back
     to the scalar oracle wholesale."""
     if batch is None:
+        # the reverse matcher never runs stage B (with_hr=False planes),
+        # so the owner-bit packer is skipped alongside conditions
         batch = encode_requests(
-            requests, compiled, skip_conditions=True
+            requests, compiled, skip_conditions=True, skip_owner_bits=True
         )
     masks = kernel.evaluate(batch)
     rule_match, rule_maskful = _rule_match_cubes(compiled, masks)

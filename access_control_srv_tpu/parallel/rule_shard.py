@@ -117,11 +117,14 @@ def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
                 sl[name] = np.concatenate([sl[name], reps], axis=0)
 
     # replicate policy/set metadata into the stacked layout
+    # hrv_role/hrv_scope stay host-side: stage B consumes the encoder's
+    # packed owner bitplanes, so only t_rs_idx (a target-table column)
+    # reaches the device
     replicated = [
         "set_valid", "set_ca", "set_has_target", "pol_valid", "pol_ca",
         "pol_effect", "pol_cacheable", "pol_has_target", "pol_has_subjects",
         "pol_n_rules", "pol_eff_ctx", "pol_has_props", "pol_ent_vals",
-        "acl_consts", "hrv_role", "hrv_scope",
+        "acl_consts",
     ]
     stacked: dict[str, np.ndarray] = {}
     for name in list(shard_arrays[0]):
@@ -271,7 +274,10 @@ class RuleShardedKernel:
         )
         kr_total = self._kr_total
 
-        shard_map = jax.shard_map
+        # jax < 0.5 exposes shard_map under jax.experimental only
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
 
         c_specs = {k: P(model_axis) for k in self._c}
 
@@ -287,15 +293,16 @@ class RuleShardedKernel:
 
             return jax.vmap(one)(batch_arrays)
 
-        self._run = jax.jit(
-            shard_map(
-                run,
-                mesh=mesh,
-                in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
-                out_specs=(P(data_axis), P(data_axis), P(data_axis)),
-                check_vma=False,
-            )
+        sm_kwargs = dict(
+            mesh=mesh,
+            in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
+            out_specs=(P(data_axis), P(data_axis), P(data_axis)),
         )
+        try:
+            wrapped = shard_map(run, check_vma=False, **sm_kwargs)
+        except TypeError:  # pre-0.6 jax spells the flag check_rep
+            wrapped = shard_map(run, check_rep=False, **sm_kwargs)
+        self._run = jax.jit(wrapped)
 
     def evaluate(self, batch: RequestBatch):
         """Batch and regex-matrix axes are padded to power-of-two buckets

@@ -331,7 +331,11 @@ class HybridEvaluator:
             with self._lock:
                 if self._compiled is compiled:
                     self._rq_kernel = rq_kernel
-        batch = encode_requests(requests, compiled, skip_conditions=True)
+        # reverse queries never reach stage B: skip the owner-bit packer
+        # (and the condition pre-pass) on this encode
+        batch = encode_requests(
+            requests, compiled, skip_conditions=True, skip_owner_bits=True
+        )
         out = what_is_allowed_batch(
             self.engine, compiled, rq_kernel, requests, batch
         )

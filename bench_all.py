@@ -43,8 +43,10 @@ backend), BENCH_SKIP_PROBE=1, BENCH_PROBE_TIMEOUT, BENCH_PROBE_RETRIES.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -54,6 +56,46 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 BASELINE_TARGET = 100_000.0
+
+# env knobs that change what a row measures: part of the per-row config
+# hash so a future verdict can tell fresh rows from stale ones (and rows
+# produced under non-default knobs from defaults)
+_CONFIG_KNOBS = (
+    "BENCH_BATCH", "STRESS_RULES", "STRESS_TOTAL", "STRESS_CHUNK",
+    "STRESS_HR_RULES", "STRESS_HR_TOTAL", "STRESS_HR_CHUNK", "SCALAR_N",
+    "WIA_N", "WIA_RULES", "WIA_LARGE_N", "HRDEEP_N", "MIXED_RULES",
+    "MIXED_CHUNK", "MIXED_TOTAL", "SERVE_RULES", "SERVE_BATCH",
+    "SERVE_CALLS", "BENCH_PLATFORM",
+)
+
+
+def _git_rev() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        rev = out.stdout.strip()
+        if out.returncode == 0 and rev:
+            dirty = subprocess.run(
+                ["git", "-C", REPO, "status", "--porcelain"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+            return rev + ("-dirty" if dirty else "")
+    except Exception:
+        pass
+    return "unknown"
+
+
+def _config_hash() -> str:
+    blob = json.dumps(
+        {k: os.environ.get(k) for k in _CONFIG_KNOBS if os.environ.get(k)},
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+_GIT_REV = None
 
 ORG = "urn:restorecommerce:acs:model:organization.Organization"
 PO = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
@@ -75,11 +117,16 @@ def _seed_engine():
 
 
 def _result(name, value, unit, extra=None):
+    global _GIT_REV
+    if _GIT_REV is None:
+        _GIT_REV = _git_rev()
     row = {
         "metric": name,
         "value": round(value, 1),
         "unit": unit,
         "vs_baseline": round(value / BASELINE_TARGET, 3),
+        "git_rev": _GIT_REV,
+        "config_hash": _config_hash(),
     }
     if extra:
         row.update(extra)
@@ -155,6 +202,10 @@ def bench_tpu_batched():
     with redirect_stdout(buf):
         bench.main()
     row = json.loads(buf.getvalue().strip().splitlines()[-1])
+    # the headline row comes from bench.py verbatim; stamp it like every
+    # other evidence row so staleness stays detectable
+    row.setdefault("git_rev", _git_rev() if _GIT_REV is None else _GIT_REV)
+    row.setdefault("config_hash", _config_hash())
     print(json.dumps(row), flush=True)
     return row
 

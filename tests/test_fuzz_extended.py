@@ -173,6 +173,76 @@ def test_extended_fuzz_all_device_paths():
     assert total_eligible > 120  # the fuzz must exercise the device path
 
 
+def _force_scoped_tree(rng: random.Random):
+    """Random tree with a roleScopingEntity forced onto EVERY role-bearing
+    subject (and random HR-disable attributes): stage B is then
+    non-trivial for every role-targeted row, driving the owner-bitplane
+    path on arbitrary random shapes instead of the curated fixtures."""
+    doc = _extended_tree(rng)
+    for ps in doc["policy_sets"]:
+        for pol in ps["policies"]:
+            for node in [pol] + list(pol.get("rules") or []):
+                tgt = node.get("target") or {}
+                subs = tgt.get("subjects") or []
+                has_role = any(a["id"] == URNS["role"] for a in subs)
+                has_scope = any(
+                    a["id"] == URNS["roleScopingEntity"] for a in subs
+                )
+                if has_role and not has_scope:
+                    subs.append({
+                        "id": URNS["roleScopingEntity"],
+                        "value": (
+                            "urn:restorecommerce:acs:model:"
+                            "organization.Organization"
+                        ),
+                    })
+                    if rng.random() < 0.25:
+                        subs.append({
+                            "id": URNS["hierarchicalRoleScoping"],
+                            "value": "false",
+                        })
+    return doc
+
+
+def test_owner_bitplane_fuzz():
+    """Owner-bitplane fuzz: fully role-scoped random trees (stage B active
+    on every role row) against request shapes covering empty owner sets,
+    deep HR closures, multi-entity owner rows and the HR-disable
+    attribute — dense kernel, prefiltered signature kernel and oracle must
+    stay bit-identical."""
+    rng = random.Random(4242)
+    total_eligible = 0
+    for round_ in range(6):
+        doc = _force_scoped_tree(rng)
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        requests = _extended_requests(rng, 40)
+        batch = encode_requests(requests, compiled)
+        dense = DecisionKernel(compiled)
+        dd, dc, ds = dense.evaluate(batch)
+        pre = force_active(PrefilteredKernel(compiled))
+        pd_, pc, ps_ = pre.evaluate(batch)
+        assert np.array_equal(dd, pd_), (
+            f"round {round_}: prefilter != dense (owner bitplanes)"
+        )
+        assert np.array_equal(dc, pc)
+        assert np.array_equal(ds, ps_)
+        for b, request in enumerate(requests):
+            if not batch.eligible[b]:
+                continue
+            expected = engine.is_allowed(copy.deepcopy(request))
+            total_eligible += 1
+            assert dd[b] == DEC_CODE[expected.decision], (
+                f"round {round_} request {b}: kernel={dd[b]} "
+                f"oracle={expected.decision}"
+            )
+    assert total_eligible > 80
+
+
 CONDITIONS = [
     "any(r.id == context.subject.id for r in (context.resources or []))",
     "context.subject.id == 'ada'",

@@ -58,6 +58,36 @@ def wire_request(role="superadministrator-r-id", action=None):
     return msg
 
 
+def test_serialize_batch_response_byte_identity():
+    """The off-dispatch-thread batch serializer must produce byte-identical
+    envelopes to protobuf's own BatchResponse serialization, across the
+    chunking threshold (length-delimited field-1 frames ARE the
+    envelope)."""
+    from access_control_srv_tpu.srv.transport_grpc import (
+        _SER_CHUNK,
+        serialize_batch_response,
+    )
+
+    def row(i):
+        return pb.Response(
+            decision=[pb.PERMIT, pb.DENY, pb.INDETERMINATE][i % 3],
+            evaluation_cacheable=bool(i % 2),
+            operation_status=pb.OperationStatus(
+                code=200 if i % 5 else 403, message=f"m{i}" * (i % 7)
+            ),
+        )
+
+    for n in (0, 1, 3, _SER_CHUNK, _SER_CHUNK + 1, 2 * _SER_CHUNK + 17):
+        responses = [row(i) for i in range(n)]
+        expected = pb.BatchResponse(responses=responses).SerializeToString()
+        assert serialize_batch_response(responses) == expected, n
+        # and the bytes parse back into the same rows
+        parsed = pb.BatchResponse.FromString(
+            serialize_batch_response(responses)
+        )
+        assert list(parsed.responses) == responses
+
+
 def test_is_allowed_over_wire(rig):
     _, client = rig
     response = client.is_allowed(wire_request())

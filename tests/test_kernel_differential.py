@@ -414,6 +414,160 @@ def test_multi_entity_property_relevance_regression():
     assert n == 2
 
 
+def _scoped_role_tree(n_roles: int, hr_disable_every: int = 3):
+    """Synthetic tree with ``n_roles`` distinct role-scoped rules: the
+    stage-B (role, scoping) vocab then has ~n_roles+1 entries, so a
+    parametrized sweep straddles the owner-bitplane word-packing
+    boundaries (ops/encode.owner_bit_layout packs ``32 // (2*(NRU+NOP))``
+    entries per int32 — 5/word at the floor caps, 8/word for op-free
+    layouts).  Every ``hr_disable_every``-th rule carries the HR-disable
+    attribute so the hr_check=False bit plane (B bits) is exercised too."""
+    ca = "urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:permit-overrides"
+    rules = []
+    for i in range(n_roles):
+        subjects = [
+            {"id": URNS["role"], "value": f"obrole-{i}"},
+            {"id": URNS["roleScopingEntity"], "value": ORG},
+        ]
+        if hr_disable_every and i % hr_disable_every == 2:
+            subjects.append(
+                {"id": URNS["hierarchicalRoleScoping"], "value": "false"}
+            )
+        rules.append({
+            "id": f"obr{i}",
+            "effect": "PERMIT" if i % 3 else "DENY",
+            "target": {
+                "subjects": subjects,
+                "resources": [
+                    {"id": URNS["entity"],
+                     "value": ENTITIES[i % len(ENTITIES)]}
+                ],
+                "actions": [
+                    {"id": URNS["actionID"],
+                     "value": ACTIONS[i % 2]}
+                ],
+            },
+        })
+    return {"policy_sets": [{
+        "id": "ob", "combining_algorithm": ca,
+        "policies": [{"id": "obp", "combining_algorithm": ca,
+                      "rules": rules}],
+    }]}
+
+
+def _owner_bit_requests(rng: random.Random, n: int):
+    """Owner-check edge cases: in/out-of-scope owners, EMPTY owner sets
+    (context resource present, meta.owners == []), multi-entity rows whose
+    instances span two runs (exercises the NRU>1 bit groups), and deep HR
+    closures."""
+    out = []
+    for i in range(n):
+        multi = rng.random() < 0.3
+        rtype = rng.sample(ENTITIES, 2) if multi else rng.choice(ENTITIES)
+        rid = [f"id-{k}" for k in range(2)] if multi else "id-0"
+        deep = rng.random() < 0.3
+        if deep:
+            depth = rng.randint(3, 6)
+
+            def node(d, j=0):
+                o = {"id": f"deep-{d}-{j}"}
+                if d < depth:
+                    o["children"] = [node(d + 1, k) for k in range(2)]
+                return o
+
+            scopes = [dict(node(0), role=f"obrole-{i % 19}")]
+            owner = f"deep-{rng.randint(0, depth)}-0"
+        else:
+            scopes = None
+            owner = rng.choice(OWNERS)
+        empty_owners = rng.random() < 0.25
+        kwargs = dict(
+            subject_id=rng.choice(SUBJECTS),
+            subject_role=f"obrole-{i % 19}",
+            role_scoping_entity=ORG,
+            role_scoping_instance=(
+                scopes[0]["id"] if deep else rng.choice(OWNERS)
+            ),
+            resource_type=rtype,
+            resource_id=rid,
+            action_type=rng.choice(ACTIONS[:2]),
+            hierarchical_scopes=scopes,
+        )
+        if not empty_owners:
+            kwargs["owner_indicatory_entity"] = ORG
+            kwargs["owner_instance"] = (
+                [owner, rng.choice(OWNERS)] if multi else owner
+            )
+        out.append(build_request(**kwargs))
+    return out
+
+
+@pytest.mark.parametrize("n_roles", [3, 4, 5, 7, 8, 9, 15, 16, 17])
+def test_owner_bitplane_vocab_boundaries(n_roles):
+    """Role-scope vocab sizes straddling the owner-bitplane packing
+    boundaries: dense kernel, prefiltered signature kernel and the scalar
+    oracle must stay bit-identical for owner-bearing, empty-owner-set,
+    HR-disabled and deep-closure rows at every vocab width."""
+    from access_control_srv_tpu.core.loader import load_policy_sets
+    from access_control_srv_tpu.ops import PrefilteredKernel
+
+    from .test_prefilter import force_active
+
+    engine = AccessController()
+    for ps in load_policy_sets(_scoped_role_tree(n_roles)):
+        engine.update_policy_set(ps)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    # the vocab carries one entry per distinct scoped role plus the
+    # ABSENT pair from unscoped target rows
+    rv = compiled.arrays["hrv_role"].shape[0]
+    assert rv >= n_roles
+
+    rng = random.Random(4000 + n_roles)
+    requests = _owner_bit_requests(rng, 48)
+    n = run_differential(engine, requests)
+    assert n > 30  # owner-bearing rows must stay kernel-eligible
+
+    batch = encode_requests(requests, compiled)
+    assert batch.arrays["r_own_bits"].shape[1] >= 1
+    dense = DecisionKernel(compiled)
+    dd, dc, ds = dense.evaluate(batch)
+    pre = force_active(PrefilteredKernel(compiled))
+    pd_, pc, ps_ = pre.evaluate(batch)
+    assert np.array_equal(dd, pd_), f"n_roles={n_roles}: prefilter != dense"
+    assert np.array_equal(dc, pc)
+    assert np.array_equal(ds, ps_)
+    assert pre._bits, "HR signature path must engage"
+
+
+def test_owner_bits_multi_run_grouping():
+    """Two entity runs with owner-bearing instances in DIFFERENT runs and
+    divergent collect outcomes per target row: the per-run bit groups
+    (r_own_runs) must not fold across runs — a regression guard for the
+    host packer's group mapping."""
+    engine = make_engine("role_scopes.yml")
+    rng = random.Random(77)
+    requests = []
+    for i in range(24):
+        requests.append(build_request(
+            subject_id="ada",
+            subject_role=["member", "manager"][i % 2],
+            role_scoping_entity=ORG,
+            role_scoping_instance=rng.choice(OWNERS),
+            resource_type=[rng.choice(ENTITIES), rng.choice(ENTITIES)],
+            resource_id=["id-0", "id-1"],
+            action_type=ACTIONS[i % 2],
+            owner_indicatory_entity=ORG,
+            owner_instance=[rng.choice(OWNERS), rng.choice(OWNERS)],
+        ))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    batch = encode_requests(requests, compiled)
+    # the batch must actually exercise multi-run bit groups
+    assert batch.arrays["r_own_runs"].shape[1] >= 2
+    n = run_differential(engine, requests)
+    assert n > 12
+
+
 def test_acl_absent_values_fall_back():
     """ADVICE r2 (high): an ACL entry whose aclIndicatoryEntity or
     aclInstance value is None interns to ABSENT; the kernel's validity

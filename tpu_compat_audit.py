@@ -136,6 +136,67 @@ def main() -> int:
     row["note"] = "executed on backend; lowered + dtype-audited"
     results.append(row)
 
+    # 2b. prefiltered signature kernel with stage B ACTIVE (every rule
+    # role-scoped — the stress-hr shape): the owner-check side must arrive
+    # as host-packed bitplanes (ops/encode.pack_owner_bitplanes), so the
+    # lowered program may contain NO dot_general — the former stage-B f32
+    # MXU matmuls cannot silently come back (static regression guard)
+    from tests.utils import build_request
+
+    engine2h, _ = bench_all._stress_engine(2000, scoped=True)
+    compiled2h = compile_policies(engine2h.policy_sets, engine2h.urns)
+    pre_hr = PrefilteredKernel(compiled2h)
+    assert pre_hr.needs_hr
+    orgs = [f"org-{j}" for j in range(4)]
+    reqs2h = []
+    for i in range(8):
+        tree = [{"id": orgs[0], "role": f"role-{i}",
+                 "children": [{"id": o} for o in orgs[1:]]}]
+        reqs2h.append(build_request(
+            subject_id=f"u{i}", subject_role=f"role-{i}",
+            role_scoping_entity=bench_all.ORG,
+            role_scoping_instance=orgs[0],
+            resource_type=(
+                f"urn:restorecommerce:acs:model:stress{i}.Stress{i}"
+            ),
+            resource_id=f"res-{i}",
+            action_type=urns["read"],
+            owner_indicatory_entity=bench_all.ORG,
+            owner_instance=orgs[1 + i % 3],
+            hierarchical_scopes=tree,
+        ))
+    batch2h = encode_requests(reqs2h, compiled2h)
+    captured_hr = {}
+    real_sig_runner_hr = pre_hr._sig_runner
+
+    def capture_sig_hr(schedule, needs_pairs=True, with_hr=False):
+        run = real_sig_runner_hr(schedule, needs_pairs, with_hr)
+
+        def wrap(*args):
+            captured_hr["sig"] = (run, args, with_hr)
+            return run(*args)
+
+        return wrap
+
+    pre_hr._sig_runner = capture_sig_hr
+    pre_hr.evaluate(batch2h)
+    pre_hr._sig_runner = real_sig_runner_hr
+    run_hr, args_hr, with_hr_flag = captured_hr["sig"]
+    assert with_hr_flag, "HR-scoped tree must compile the stage-B variant"
+    hlo_hr = run_hr.lower(
+        *[jnp.asarray(a) if isinstance(a, np.ndarray) else a
+          for a in args_hr]
+    ).as_text()
+    row = audit_text("prefiltered-sig+hr-bitplanes", hlo_hr)
+    n_dots = len(re.findall(r"\bdot_general\b", hlo_hr))
+    row["dot_general_ops"] = n_dots
+    row["ok"] = bool(row["ok"] and n_dots == 0)
+    row["note"] = (
+        "stage-B owner checks consume host-packed bitplanes; program must "
+        "contain zero dot_general (former MXU matmul regression guard)"
+    )
+    results.append(row)
+
     # 3. reverse-query kernel: capture the signature-planes runner the
     # same way (the per-row side is host numpy by design — ops/reverse.py)
     rq = ReverseQueryKernel(compiled, engine.policy_sets)
