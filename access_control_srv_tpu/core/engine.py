@@ -79,6 +79,16 @@ _METHOD_ALIASES = {
 }
 
 
+def apply_resolved_subject(subject, payload) -> None:
+    """Graft a resolved identity payload onto a token-bearing subject —
+    the exact field set the reference copies (accessController.ts:110-117).
+    Shared by the per-request resolution above and the batched host
+    pipeline (srv/evaluator.HybridEvaluator.prepare_batch)."""
+    subject["id"] = _get(payload, "id")
+    subject["tokens"] = _get(payload, "tokens")
+    subject["role_associations"] = _get(payload, "role_associations")
+
+
 
 
 class AccessController:
@@ -133,24 +143,26 @@ class AccessController:
         request._context_prepared = True
         context = request.context or {}
         if _get(_get(context, "subject"), "token"):
-            context = self._resolve_subject(context)
+            request._token_resolved = self._resolve_subject(context)
             if not _get(_get(context, "subject"), "hierarchical_scopes"):
                 context = self.create_hr_scope(context)
             request.context = context
 
-    def _resolve_subject(self, context) -> Any:
-        """Token -> subject resolution via the identity client
-        (reference: accessController.ts:110-117)."""
+    def _resolve_subject(self, context) -> bool:
+        """Token -> subject resolution via the identity client, mutating the
+        subject in place (reference: accessController.ts:110-117).  Returns
+        whether a payload was applied — the encoder keeps resolved
+        token-bearing rows kernel-eligible (``request._token_resolved``)
+        and degrades unresolved ones to the oracle exactly as before."""
         subject = _get(context, "subject")
         token = _get(subject, "token")
         if token and self.identity_client is not None:
             resolved = self.identity_client.find_by_token(token)
             payload = _get(resolved, "payload")
             if payload:
-                subject["id"] = _get(payload, "id")
-                subject["tokens"] = _get(payload, "tokens")
-                subject["role_associations"] = _get(payload, "role_associations")
-        return context
+                apply_resolved_subject(subject, payload)
+                return True
+        return False
 
     def create_hr_scope(self, context):
         """Resolve hierarchical scopes for a token-bearing subject via the

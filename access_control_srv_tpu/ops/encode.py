@@ -9,8 +9,12 @@ matcher the kernel implements; ineligible requests are flagged and served
 by the scalar oracle instead (decisions stay bit-identical either way).
 Ineligibility triggers:
 
-- a subject token (identity resolution / HR-scope rendezvous is a host
-  protocol, reference: src/core/accessController.ts:110-123);
+- a subject token the host pipeline has NOT resolved (identity resolution
+  / HR-scope rendezvous is a host protocol, reference:
+  src/core/accessController.ts:110-123).  Resolved token rows — prepared
+  by srv/evaluator.prepare_batch or engine.prepare_context — encode their
+  resolved subject and stay on device; failed resolutions degrade per-row
+  to the oracle;
 - attribute counts beyond the padding caps (including ACL scoping-entity/
   instance counts and distinct HR-tree role counts);
 - malformed property URNs, properties preceding their entity, or
@@ -18,12 +22,17 @@ Ineligibility triggers:
   reference matches properties to entities by substring, reference:
   :515-516);
 - conditions with context queries when a resource adapter is configured
-  (the reference mutates request.context across rules in that path,
-  reference: :238-254).
+  AND the row's walk could observe the reference's context merge (the
+  reference mutates request.context across rules in that path, reference:
+  :238-254).  Rows reaching exactly one query rule whose merge provably
+  stays invisible get the query PREFETCHED host-side and ride the kernel
+  (_prefetch_context_queries); see docs/ELIGIBILITY.md for the full
+  taxonomy and degradation ladder.
 """
 
 from __future__ import annotations
 
+import copy
 import re
 from dataclasses import dataclass, field
 from typing import Optional
@@ -614,8 +623,20 @@ def encode_requests(
         raw_subject = get_field(context, "subject")
         subject = raw_subject or {}
         if get_field(subject, "token"):
-            mark(b, "token-subject")
-            continue
+            # Token-bearing rows stay kernel-eligible once the host
+            # pipeline has resolved them (srv/evaluator.prepare_batch /
+            # core/engine.prepare_context): resolution mutates the subject
+            # in place and the oracle's own prepare_context is a no-op
+            # afterwards, so kernel and oracle evaluate the identical
+            # resolved context by construction.  Unprepared rows (wire/
+            # native path, direct encodes) and failed resolutions degrade
+            # per-row to the oracle exactly as before.
+            if not getattr(request, "_context_prepared", False):
+                mark(b, "token-subject")
+                continue
+            if not getattr(request, "_token_resolved", False):
+                mark(b, "token-unresolved")
+                continue
         if raw_subject is None:
             # quirk parity: a matched rule's ACL check dereferences
             # context.subject without a guard in the reference
@@ -914,24 +935,32 @@ def encode_requests(
     cond_code = np.full((C, B), 200, np.int32)
     cand_cache: dict[tuple, np.ndarray] = {}
     cond_msg: dict[tuple[int, int], str] = {}
-    for ci, cc in enumerate([] if skip_conditions else compiled.conditions):
-        has_query = cc.context_query is not None and (
-            getattr(cc.context_query, "filters", None)
-            or getattr(cc.context_query, "query", None)
-        )
-        if has_query and resource_adapter is not None:
-            # adapter-driven context queries pull resources inside the rule
-            # loop and mutate request.context for later rules (reference:
-            # accessController.ts:227-254), which the pre-pass cannot
-            # replay.  Fall back PER ROW: only rows this rule could reach
-            # (its target row is a match candidate for the row's resource
-            # signature — candidacy over-approximates the kernel's target
-            # match) leave the device; unreachable rows never pull, so
-            # their pre-pass results stay exact.
-            _mark_context_query_rows(
-                compiled, cc, a, eligible, mark, rgx_set, cand_cache
+    cond_list = [] if skip_conditions else compiled.conditions
+    query_cis: set[int] = set()
+    if resource_adapter is not None:
+        query_cis = {
+            ci for ci, cc in enumerate(cond_list)
+            if cc.context_query is not None and (
+                getattr(cc.context_query, "filters", None)
+                or getattr(cc.context_query, "query", None)
             )
-            continue
+        }
+    if query_cis:
+        # adapter-driven context queries pull resources inside the rule
+        # loop and MERGE the result into request.context for the rule's own
+        # condition (and everything evaluated after it — reference:
+        # accessController.ts:227-254).  The prefetch plan keeps a row on
+        # device when that merge provably cannot leak into any later
+        # context read (see _prefetch_context_queries); every other
+        # candidate row degrades per-row to the oracle as before.
+        _prefetch_context_queries(
+            compiled, cond_list, sorted(query_cis), a, eligible, mark,
+            rgx_set, cand_cache, requests, resource_adapter,
+            cond_true, cond_abort, cond_code, cond_msg,
+        )
+    for ci, cc in enumerate(cond_list):
+        if ci in query_cis:
+            continue  # handled by the prefetch plan above
         for b, request in enumerate(requests):
             if not eligible[b]:
                 continue
@@ -966,46 +995,192 @@ def encode_requests(
     )
 
 
-def _mark_context_query_rows(
-    compiled, cc, a, eligible, mark, rgx_set, cand_cache
-) -> None:
-    """Per-row oracle fallback for one adapter-backed context-query rule:
-    clears ``eligible`` for rows whose resource signature makes the rule's
-    target a match candidate (ops/prefilter.py candidacy — a sound
-    over-approximation of the kernel's target match, so every row kept on
-    device provably never reaches the rule)."""
+def _row_candidates(compiled, a, b, rgx_set, cand_cache):
+    """(signature key, candidate target-row vector [T]) for request row
+    ``b`` — ops/prefilter.py candidacy, a sound over-approximation of the
+    kernel's target match, cached per distinct resource/action signature.
+    Candidacy depends only on ``request.target`` (never on context), so it
+    is invariant under the reference's context merge."""
     from .prefilter import candidate_rows
 
-    KP, KR = compiled.KP, compiled.KR
-    s, rem = divmod(cc.rule_flat_index, KP * KR)
-    kp, kr = divmod(rem, KR)
-    if not bool(compiled.arrays["rule_has_target"][s, kp, kr]):
-        for b in np.nonzero(eligible)[0]:
-            mark(b, "context-query")  # untargeted rule: reachable everywhere
-        return
-    row = int(compiled.arrays["rule_target"][s, kp, kr])
-    for b in np.nonzero(eligible)[0]:
-        ents = a["r_ent_vals"][b]
-        cols = a["r_ent_e"][b]
-        valid = ents >= 0
-        ent_ids = np.unique(ents[valid])
-        ent_cols = np.array(
-            [cols[valid][ents[valid] == e][0] for e in ent_ids], np.int64
+    ents = a["r_ent_vals"][b]
+    cols = a["r_ent_e"][b]
+    valid = ents >= 0
+    ent_ids = np.unique(ents[valid])
+    ent_cols = np.array(
+        [cols[valid][ents[valid] == e][0] for e in ent_ids], np.int64
+    )
+    ops = a["r_op_vals"][b]
+    op_ids = np.unique(ops[ops >= 0])
+    acts = a["r_act_vals"][b]
+    act_vals = np.unique(acts[acts >= 0])
+    key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
+           tuple(act_vals.tolist()))
+    cand = cand_cache.get(key)
+    if cand is None:
+        cand = candidate_rows(
+            compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
         )
-        ops = a["r_op_vals"][b]
-        op_ids = np.unique(ops[ops >= 0])
-        acts = a["r_act_vals"][b]
-        act_vals = np.unique(acts[acts >= 0])
-        key = (tuple(ent_ids.tolist()), tuple(op_ids.tolist()),
-               tuple(act_vals.tolist()))
-        cand = cand_cache.get(key)
-        if cand is None:
-            cand = candidate_rows(
-                compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
-            )
-            cand_cache[key] = cand
-        if cand[row]:
+        cand_cache[key] = cand
+    return key, cand
+
+
+def _merge_safe(compiled, flat_index, s_r, kp_r, cand, row_acl_ok) -> bool:
+    """True when prefetching query rule R's context pull on the host
+    provably cannot change any decision for this row signature.
+
+    The reference's pull replaces ``request.context`` with the merged
+    ``{"target", "context", "_queryResult"}`` object, so everything
+    evaluated AFTER R in walk order loses ``context.subject`` and
+    ``context.resources``.  The kernel encodes every stage from the
+    ORIGINAL context, so a row is fusable only when no node after R can
+    read the context at all:
+
+    - a rule after R reads context through its role-gated subject match,
+      its HR-scope check (scoping entity), its condition, or its
+      post-match ACL verification (which, with the merged context, early
+      all-clears when the row's original ACL state was the no-metadata
+      all-clear ``r_acl_short == 1``, and diverges otherwise);
+    - a policy evaluated after R's policy reads context when its target is
+      role-gated or carries a scoping entity (policy_subject_match);
+    - a later set's target match reads context only through a role-gated
+      subject.
+
+    Nodes whose targets are not candidates for the row's signature cannot
+    match in either world (candidacy is context-free), so they are safe by
+    construction."""
+    arr = compiled.arrays
+    S, KP, KR = compiled.S, compiled.KP, compiled.KR
+    rt = arr["rule_target"]
+    rht = arr["rule_has_target"]
+    has_role = arr["t_has_role"]
+    has_scoping = arr["t_has_scoping"]
+    skip_acl = arr["t_skip_acl"]
+    has_cond = arr["rule_cond"] >= 0
+    later = np.arange(S * KP * KR).reshape(S, KP, KR) > flat_index
+    reach_t = rht & cand[rt]
+    ctx_read = (
+        (~rht & has_cond)
+        | (reach_t & (
+            has_role[rt] | has_scoping[rt] | has_cond
+            | ~(skip_acl[rt] | row_acl_ok)
+        ))
+    )
+    if (arr["rule_valid"] & later & ctx_read).any():
+        return False
+    pol_later = np.arange(S * KP).reshape(S, KP) > (s_r * KP + kp_r)
+    pt = arr["pol_target"]
+    pol_ctx = (
+        arr["pol_valid"] & arr["pol_has_target"] & cand[pt]
+        & (has_role[pt] | has_scoping[pt])
+    )
+    if (pol_ctx & pol_later).any():
+        return False
+    st = arr["set_target"]
+    set_ctx = (
+        arr["set_valid"] & arr["set_has_target"] & cand[st] & has_role[st]
+    )
+    if (set_ctx & (np.arange(S) > s_r)).any():
+        return False
+    return True
+
+
+def _prefetch_context_queries(
+    compiled, cond_list, query_cis, a, eligible, mark, rgx_set, cand_cache,
+    requests, adapter, cond_true, cond_abort, cond_code, cond_msg,
+) -> None:
+    """Stage (b) of the host eligibility pipeline: for every row that can
+    reach exactly ONE adapter-backed context-query rule R and whose later
+    walk provably never reads the merged context (_merge_safe), pull R's
+    context query concurrently over the pooled transport and evaluate R's
+    condition against the SAME merged view the reference builds
+    (accessController.ts:227-254, pull_context_resources) — the row then
+    rides the kernel.  Rows reaching several query rules, rows whose later
+    walk could observe the merge, and rows whose prefetch fails (after the
+    adapter's one transient retry, srv/adapters.py) degrade per-row to the
+    scalar oracle, never to a changed decision."""
+    arr = compiled.arrays
+    KP, KR = compiled.KP, compiled.KR
+    rule_pos = []
+    for ci in query_cis:
+        flat = cond_list[ci].rule_flat_index
+        s, rem = divmod(flat, KP * KR)
+        kp, kr = divmod(rem, KR)
+        rule_pos.append((ci, flat, s, kp, kr))
+    safety_cache: dict[tuple, bool] = {}
+    jobs: list[tuple[int, int]] = []
+    for b in np.nonzero(eligible)[0]:
+        b = int(b)
+        key, cand = _row_candidates(compiled, a, b, rgx_set, cand_cache)
+        reach = []
+        for ci, flat, s, kp, kr in rule_pos:
+            if arr["rule_has_target"][s, kp, kr]:
+                if cand[int(arr["rule_target"][s, kp, kr])]:
+                    reach.append((ci, flat, s, kp, kr))
+            else:
+                reach.append((ci, flat, s, kp, kr))  # reachable everywhere
+        if not reach:
+            continue  # provably never pulls: pre-pass results stay exact
+        if len(reach) > 1:
+            # a second pull would see the first pull's merged context (and
+            # resolve its filters against it); not replayable host-side
             mark(b, "context-query")
+            continue
+        ci, flat, s, kp, kr = reach[0]
+        row_acl_ok = int(a["r_acl_short"][b]) == 1
+        if arr["rule_has_target"][s, kp, kr]:
+            # R's own ACL verification runs on the MERGED context in the
+            # reference (verifyACL after the condition): only rows whose
+            # original ACL state is the no-metadata early all-clear (or a
+            # skipACL rule) behave identically in both worlds
+            rt = int(arr["rule_target"][s, kp, kr])
+            if not (bool(arr["t_skip_acl"][rt]) or row_acl_ok):
+                mark(b, "context-query")
+                continue
+        skey = (key, ci, row_acl_ok)
+        safe = safety_cache.get(skey)
+        if safe is None:
+            safe = _merge_safe(compiled, flat, s, kp, cand, row_acl_ok)
+            safety_cache[skey] = safe
+        if not safe:
+            mark(b, "context-query")
+            continue
+        jobs.append((ci, b))
+    if not jobs:
+        return
+    # concurrent prefetch: filters resolve against the ORIGINAL request
+    # (no earlier pull can reach these rows), exactly as the reference's
+    # first pull would
+    pairs = [(cond_list[ci].context_query, requests[b]) for ci, b in jobs]
+    if hasattr(adapter, "query_many"):
+        results = adapter.query_many(pairs)
+    else:
+        results = []
+        for cq, request in pairs:
+            try:
+                results.append(adapter.query(cq, request))
+            except Exception as err:  # noqa: BLE001 — per-row fallback
+                results.append(err)
+    for (ci, b), result in zip(jobs, results):
+        if isinstance(result, Exception):
+            mark(b, "context-query-error")
+            continue
+        request = requests[b]
+        merged = copy.copy(request)
+        # the reference's pull_context_resources merge shape, verbatim
+        merged.context = {
+            "target": request.target,
+            "context": request.context,
+            "_queryResult": result,
+        }
+        cc = cond_list[ci]
+        try:
+            cond_true[ci, b] = bool(condition_matches(cc.condition, merged))
+        except Exception as err:  # deny-by-default with the error code
+            code = getattr(err, "code", 500)
+            cond_abort[ci, b] = True
+            cond_code[ci, b] = code if isinstance(code, int) else 500
+            cond_msg[(ci, b)] = str(err) or "Unknown Error!"
 
 
 def _encode_owners(

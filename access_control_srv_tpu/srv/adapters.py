@@ -24,7 +24,9 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
+import time
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
@@ -38,6 +40,10 @@ from ..core.errors import (
 
 DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_MAX_CONCURRENCY = 8
+# transient (5xx) transport failures are retried ONCE after a jittered
+# backoff before the row falls back to the slow path — a single blip at the
+# endpoint must not demote a whole batch slice to the oracle walk
+DEFAULT_RETRY_BACKOFF_S = 0.05
 
 
 class ResourceAdapter:
@@ -129,6 +135,8 @@ class GraphQLAdapter(ResourceAdapter):
         transport: Optional[Callable[[str, bytes, dict], bytes]] = None,
         timeout_s: float | None = None,
         max_concurrency: int | None = None,
+        retry_transient: bool | None = None,
+        retry_backoff_s: float | None = None,
     ):
         self.url = url
         self.logger = logger
@@ -143,6 +151,17 @@ class GraphQLAdapter(ResourceAdapter):
             if max_concurrency is not None
             else self.client_opts.get("max_concurrency",
                                       DEFAULT_MAX_CONCURRENCY)
+        )
+        self.retry_transient = bool(
+            self.client_opts.get("retry_transient", True)
+            if retry_transient is None
+            else retry_transient
+        )
+        self.retry_backoff_s = float(
+            retry_backoff_s
+            if retry_backoff_s is not None
+            else self.client_opts.get("retry_backoff_s",
+                                      DEFAULT_RETRY_BACKOFF_S)
         )
         self._pool: Optional[_ConnectionPool] = None
         self._pool_lock = threading.Lock()
@@ -197,13 +216,36 @@ class GraphQLAdapter(ResourceAdapter):
             variables["filters"] = filters
         return variables
 
+    def _transport_with_retry(self, body: bytes, headers: dict) -> bytes:
+        """One jittered retry on a transient (5xx) transport failure before
+        the caller's deny/oracle degradation; 4xx responses and payload
+        errors are definitive and surface immediately."""
+        try:
+            return self.transport(self.url, body, headers)
+        except ContextQueryTransportError as err:
+            code = getattr(err, "code", None)
+            if (
+                not self.retry_transient
+                or not isinstance(code, int)
+                or not 500 <= code < 600
+            ):
+                raise
+            delay = self.retry_backoff_s * (0.5 + random.random())
+            if self.logger:
+                self.logger.warning(
+                    "transient context-query failure (%s); retrying once "
+                    "in %.0f ms", code, delay * 1e3,
+                )
+            time.sleep(delay)
+            return self.transport(self.url, body, headers)
+
     def query(self, context_query, request) -> Any:
         gql_query = getattr(context_query, "query", "") or ""
         variables = self._resolve_filters(context_query, request)
         body = json.dumps({"query": gql_query, "variables": variables}).encode()
         headers = {"Content-Type": "application/json"}
         headers.update(self.client_opts.get("headers", {}))
-        raw = self.transport(self.url, body, headers)
+        raw = self._transport_with_retry(body, headers)
         try:
             payload = json.loads(raw)
         except (TypeError, ValueError) as exc:
@@ -257,6 +299,12 @@ def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
             timeout_s=adapter_config.get("timeout_s", opts.get("timeout_s")),
             max_concurrency=adapter_config.get(
                 "max_concurrency", opts.get("max_concurrency")
+            ),
+            retry_transient=adapter_config.get(
+                "retry_transient", opts.get("retry_transient")
+            ),
+            retry_backoff_s=adapter_config.get(
+                "retry_backoff_s", opts.get("retry_backoff_s")
             ),
         )
     raise UnsupportedResourceAdapter(adapter_config)

@@ -6,14 +6,22 @@ throughput by batching.  Concurrent callers submit requests; a collector
 drains the queue every ``window_ms`` (or at ``max_batch``) and evaluates
 the whole batch through the hybrid evaluator, resolving each caller's
 future.  Single outstanding requests skip the device path entirely (the
-oracle answers faster than an encode + device round-trip)."""
+oracle answers faster than an encode + device round-trip).
+
+Pipelining: evaluation runs on a dedicated single-worker executor while
+the collector keeps collecting AND runs the host-side eligibility pipeline
+(``evaluator.prepare_batch``: batched token resolution + HR-scope
+rendezvous) for batch i+1 — host RPC latency for the next batch overlaps
+device execution of the current one.  At most one batch is queued behind
+the one evaluating, so backpressure still reaches callers through their
+futures."""
 
 from __future__ import annotations
 
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
 from ..models.model import Request, Response
@@ -34,10 +42,15 @@ class MicroBatcher:
         self._queue: "queue.Queue[tuple[Request, Future]]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._eval_pool: Optional[ThreadPoolExecutor] = None
+        self._inflight: list = []  # evaluation futures, FIFO
         self._last_batch = 0  # previous round's size (regime detector)
 
     def start(self) -> None:
         if self._thread is None:
+            self._eval_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="acs-batch-eval"
+            )
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
@@ -46,6 +59,10 @@ class MicroBatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._eval_pool is not None:
+            self._eval_pool.shutdown(wait=True)
+            self._eval_pool = None
+        self._inflight = []
 
     def submit(self, request: Request) -> "Future[Response]":
         future: "Future[Response]" = Future()
@@ -106,22 +123,48 @@ class MicroBatcher:
             except queue.Empty:
                 pass
             self._last_batch = len(batch)
-            requests = [req for req, _ in batch]
-            responses = None
-            if len(batch) >= self.min_kernel_batch:
+            # host-side eligibility pipeline for THIS batch runs on the
+            # collector thread while the PREVIOUS batch is still evaluating
+            # on the eval worker — token resolution / HR rendezvous latency
+            # overlaps device execution (prepare_batch is idempotent; a
+            # failure here just leaves rows unprepared, and the encoder
+            # degrades them to the oracle)
+            prepare = getattr(self.evaluator, "prepare_batch", None)
+            if prepare is not None:
                 try:
-                    responses = self.evaluator.is_allowed_batch(requests)
+                    prepare([req for req, _ in batch])
                 except Exception:
-                    # one poisoned request must not deny the whole batch;
-                    # retry each request individually below
-                    responses = None
-            if responses is not None:
-                for (_, future), response in zip(batch, responses):
-                    future.set_result(response)
-            else:
-                for req, future in batch:
-                    try:
-                        future.set_result(self.evaluator.is_allowed(req))
-                    except Exception as err:
-                        if not future.done():
-                            future.set_exception(err)
+                    pass
+            # bounded pipeline: one batch evaluating + one queued at most
+            while len(self._inflight) >= 2:
+                self._inflight.pop(0).result()
+            self._inflight = [f for f in self._inflight if not f.done()]
+            self._inflight.append(
+                self._eval_pool.submit(self._eval_batch, batch)
+            )
+        for fut in self._inflight:
+            fut.result()
+        self._inflight = []
+
+    def _eval_batch(self, batch: list) -> None:
+        """Evaluate one collected batch and resolve its futures; runs on
+        the single eval worker so batches complete in submission order."""
+        requests = [req for req, _ in batch]
+        responses = None
+        if len(batch) >= self.min_kernel_batch:
+            try:
+                responses = self.evaluator.is_allowed_batch(requests)
+            except Exception:
+                # one poisoned request must not deny the whole batch;
+                # retry each request individually below
+                responses = None
+        if responses is not None:
+            for (_, future), response in zip(batch, responses):
+                future.set_result(response)
+        else:
+            for req, future in batch:
+                try:
+                    future.set_result(self.evaluator.is_allowed(req))
+                except Exception as err:
+                    if not future.done():
+                        future.set_exception(err)

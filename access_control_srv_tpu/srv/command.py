@@ -108,6 +108,14 @@ class CommandInterface:
                 # hit/miss/eviction counters + hit ratio on the health
                 # surface (the operator-facing cache-efficacy signal)
                 detail["decision_cache"] = decision_cache.stats()
+            identity_client = getattr(
+                self.service.engine, "identity_client", None
+            )
+            if hasattr(identity_client, "cache_stats"):
+                # token-resolution cache efficacy: the host eligibility
+                # pipeline's per-batch RPC amortizer (srv/identity.py)
+                detail["token_resolution_cache"] = \
+                    identity_client.cache_stats()
         except Exception as err:  # pragma: no cover
             healthy = False
             detail["error"] = str(err)

@@ -247,6 +247,130 @@ class HybridEvaluator:
         self._count_path("native-wire", n_served)
         return batch, decision, cacheable, status
 
+    # ------------------------------------------------- host-side pipeline
+
+    def prepare_batch(self, requests: list) -> None:
+        """Host-side eligibility pipeline, stage (a): batch-resolve every
+        distinct ``subject.token`` through the identity client (one RPC per
+        distinct token — the TTL'd resolution cache makes repeats across
+        batches nearly free) and the HR-scope rendezvous (one rendezvous
+        per distinct cache key), then mark each request prepared so the
+        encoder keeps resolved token rows on the kernel path.
+
+        Idempotent and semantics-preserving by construction: after this,
+        ``engine.prepare_context`` is a no-op for these requests, so kernel
+        and oracle evaluate the identical resolved context.  Resolution
+        failures leave ``request._token_resolved`` False and the row
+        degrades per-row to the oracle exactly as unprepared token traffic
+        does.  Callers that overlap device execution of batch i with this
+        call for batch i+1 (srv/batcher.py) get the pipelining for free —
+        everything here is host-only."""
+        from ..core.common import get_field
+        from ..core.engine import apply_resolved_subject
+
+        engine = self.engine
+        pending: list[tuple] = []
+        for request in requests:
+            if getattr(request, "_context_prepared", False):
+                continue
+            context = request.context
+            subject = get_field(context, "subject") if context else None
+            token = get_field(subject, "token") if subject is not None else None
+            if token:
+                pending.append((request, token))
+        if not pending:
+            return
+
+        client = engine.identity_client
+
+        def resolve(token):
+            try:
+                return client.find_by_token(token)
+            except Exception as err:  # noqa: BLE001 — fail the row closed
+                if self.logger:
+                    self.logger.warning(
+                        "batch token resolution failed: %s", err
+                    )
+                return None
+
+        by_token: dict[str, list] = {}
+        for request, token in pending:
+            by_token.setdefault(token, []).append(request)
+        resolutions: dict[str, object] = {}
+        if client is not None:
+            tokens = list(by_token)
+            if len(tokens) == 1:
+                resolutions[tokens[0]] = resolve(tokens[0])
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=min(8, len(tokens))
+                ) as pool:
+                    for token, resolved in zip(
+                        tokens, pool.map(resolve, tokens)
+                    ):
+                        resolutions[token] = resolved
+
+        n_ok = n_fail = 0
+        for token, rows in by_token.items():
+            resolved = resolutions.get(token)
+            payload = get_field(resolved, "payload") if resolved else None
+            for request in rows:
+                request._context_prepared = True
+                if payload is not None:
+                    # per-request copy: rows sharing a token must not share
+                    # mutable payload objects
+                    apply_resolved_subject(
+                        get_field(request.context, "subject"),
+                        copy.deepcopy(payload),
+                    )
+                    request._token_resolved = True
+                    n_ok += 1
+                else:
+                    request._token_resolved = False
+                    n_fail += 1
+        self._count_path("token-resolved", n_ok)
+        self._count_path("token-unresolved", n_fail)
+
+        # HR scopes: one rendezvous per distinct cache key; the remaining
+        # rows of each group read the freshly-written cache (no second
+        # rendezvous).  A timed-out key leaves its whole group scope-less —
+        # the same per-row outcome the reference's individual waits produce.
+        provider = engine.hr_scope_provider
+        if provider is None:
+            return
+        groups: dict[str, list] = {}
+        for request, _ in pending:
+            if not getattr(request, "_token_resolved", False):
+                continue
+            subject = get_field(request.context, "subject")
+            if get_field(subject, "hierarchical_scopes"):
+                continue
+            key = provider.hr_scopes_key(request.context)
+            if key is not None:
+                groups.setdefault(key, []).append(request)
+        if not groups:
+            return
+        firsts = [rows[0] for rows in groups.values()]
+        if len(firsts) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, len(firsts))) as pool:
+                list(pool.map(
+                    lambda r: engine.create_hr_scope(r.context), firsts
+                ))
+        else:
+            engine.create_hr_scope(firsts[0].context)
+        for key, rows in groups.items():
+            for request in rows[1:]:
+                try:
+                    cached = provider.cache.exists(key)
+                except Exception:  # noqa: BLE001 — cache backend hiccup
+                    cached = True  # fall through to the normal path
+                if cached:
+                    engine.create_hr_scope(request.context)
+
     # ------------------------------------------------------------ evaluation
 
     def is_allowed(self, request) -> Response:
@@ -303,6 +427,7 @@ class HybridEvaluator:
         REVERSE_MIN_RULES and above."""
         from ..ops.reverse import REVERSE_MIN_RULES
 
+        self.prepare_batch(requests)
         with self._lock:
             # one consistent snapshot: kernel/compiled/tree always published
             # together, so kernel != None implies compiled.supported
@@ -353,6 +478,7 @@ class HybridEvaluator:
         encode (hit rows skip the device round-trip and the oracle walk),
         then the kernel/oracle hybrid over the miss rows, then write-through
         of every miss row the engine marked ``evaluation_cacheable``."""
+        self.prepare_batch(requests)
         cache = self.decision_cache
         if cache is None or not cache.enabled:
             return self._is_allowed_batch_uncached(requests)

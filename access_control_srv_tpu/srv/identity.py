@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import copy
 import threading
-from typing import Any, Optional, Protocol
+import time
+from typing import Optional, Protocol
 
 
 class IdentityClient(Protocol):
@@ -20,6 +21,200 @@ class IdentityClient(Protocol):
         """Returns ``{"payload": {"id", "tokens", "role_associations", ...}}``
         or None."""
         ...
+
+
+class TokenResolutionCache:
+    """TTL'd token -> resolution-envelope cache with negative-result caching.
+
+    Entries are whole ``find_by_token`` envelopes (``{"payload", "status"}``).
+    Positive resolutions live ``ttl_s``; *definitive* negatives (payload None
+    with a non-5xx status, e.g. 404) live ``negative_ttl_s`` so hammering an
+    unknown token costs one RPC per window.  Transport-level failures (5xx)
+    are never cached — recovery after an identity-service outage must be
+    immediate.
+
+    Eviction race: ``lookup`` returns a generation snapshot and ``store``
+    refuses to insert when an ``evict``/``evict_subject`` landed in between —
+    an in-flight resolution that began before a ``userModified`` eviction can
+    never repopulate the cache with its possibly-stale payload.
+
+    ``evict_subject`` uses the subject-id recorded from each positive
+    payload, so ``userDeleted`` (which carries only the user id, no tokens)
+    still drops every resolution for that subject.
+
+    All access is lock-guarded; entries cross the boundary as deep copies so
+    caller mutation cannot corrupt future hits.  ``counter`` is an optional
+    Counter-like (``.inc(key, by)``) receiving hits/misses/negative-hits/
+    evictions/expirations (srv/telemetry.Telemetry.identity)."""
+
+    def __init__(
+        self,
+        ttl_s: float = 600.0,
+        negative_ttl_s: float = 30.0,
+        max_entries: int = 4096,
+        counter=None,
+        time_fn=time.monotonic,
+    ):
+        self.ttl_s = float(ttl_s)
+        self.negative_ttl_s = float(negative_ttl_s)
+        self.max_entries = int(max_entries)
+        self._time = time_fn
+        self._counter = counter
+        # token -> (expires_at, subject_id, envelope); dict order is the LRU
+        self._data: dict[str, tuple[float, Optional[str], dict]] = {}
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._stats = {
+            "hits": 0, "misses": 0, "negative_hits": 0,
+            "evictions": 0, "expirations": 0,
+        }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def _count(self, key: str, by: int = 1) -> None:
+        self._stats[key] += by
+        if self._counter is not None:
+            self._counter.inc(key.replace("_", "-"), by)
+
+    @property
+    def gen(self) -> int:
+        with self._lock:
+            return self._gen
+
+    def lookup(self, token: str) -> tuple[Optional[dict], int]:
+        """(cached envelope copy or None, generation snapshot for store)."""
+        now = self._time()
+        with self._lock:
+            gen = self._gen
+            hit = self._data.get(token)
+            if hit is not None and hit[0] <= now:
+                del self._data[token]
+                self._count("expirations")
+                hit = None
+            if hit is None:
+                self._count("misses")
+                return None, gen
+            # LRU touch: re-insert at the back of the dict order
+            self._data[token] = self._data.pop(token)
+            self._count("hits")
+            if hit[2].get("payload") is None:
+                self._count("negative_hits")
+            entry = hit[2]
+        # copy outside the lock: hits must not serialize on copy cost
+        return copy.deepcopy(entry), gen
+
+    def store(self, token: str, envelope: dict, gen: int) -> bool:
+        """Insert a resolution unless an eviction raced it; returns whether
+        the entry was cached."""
+        payload = envelope.get("payload")
+        status = envelope.get("status") or {}
+        code = status.get("code")
+        if payload is None:
+            if not isinstance(code, int) or code >= 500:
+                return False  # transport failure: never cached
+            ttl = self.negative_ttl_s
+        else:
+            ttl = self.ttl_s
+        if ttl <= 0 or self.max_entries <= 0:
+            return False
+        subject_id = payload.get("id") if isinstance(payload, dict) else None
+        entry = copy.deepcopy(envelope)
+        expires_at = self._time() + ttl
+        with self._lock:
+            if gen != self._gen:
+                # an evict() landed while this resolution was in flight —
+                # the payload may predate the user mutation that triggered
+                # it, so it must not repopulate the cache
+                return False
+            while self._data and len(self._data) >= self.max_entries:
+                self._data.pop(next(iter(self._data)))
+                self._count("evictions")
+            self._data[token] = (expires_at, subject_id, entry)
+        return True
+
+    def evict(self, token: Optional[str] = None) -> int:
+        """Drop cached resolutions (all, or one token) on user mutation."""
+        with self._lock:
+            self._gen += 1
+            if token is None:
+                n = len(self._data)
+                self._data.clear()
+            else:
+                n = 1 if self._data.pop(token, None) is not None else 0
+            self._count("evictions", n)
+        return n
+
+    def evict_subject(self, subject_id: str) -> int:
+        """Drop every resolution whose payload belongs to ``subject_id``
+        (userDeleted carries no token list)."""
+        if subject_id is None:
+            return 0
+        with self._lock:
+            self._gen += 1
+            stale = [
+                tok for tok, (_, sid, _) in self._data.items()
+                if sid == subject_id
+            ]
+            for tok in stale:
+                del self._data[tok]
+            self._count("evictions", len(stale))
+        return len(stale)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["entries"] = len(self._data)
+        looked = out["hits"] + out["misses"]
+        out["hit_ratio"] = round(out["hits"] / looked, 4) if looked else None
+        return out
+
+
+class CachingIdentityClient:
+    """TTL'd resolution cache around ANY identity client (the static map in
+    tests/benches, custom transports in deployments).  GrpcIdentityClient
+    carries the same cache built in — do not stack both."""
+
+    def __init__(
+        self,
+        inner,
+        ttl_s: float = 600.0,
+        negative_ttl_s: float = 30.0,
+        max_entries: int = 4096,
+        counter=None,
+    ):
+        self.inner = inner
+        self.cache = TokenResolutionCache(
+            ttl_s=ttl_s, negative_ttl_s=negative_ttl_s,
+            max_entries=max_entries, counter=counter,
+        )
+
+    def find_by_token(self, token: str) -> Optional[dict]:
+        hit, gen = self.cache.lookup(token)
+        if hit is not None:
+            return hit
+        out = self.inner.find_by_token(token)
+        if isinstance(out, dict):
+            self.cache.store(token, out, gen)
+        return out
+
+    def evict(self, token: Optional[str] = None) -> None:
+        self.cache.evict(token)
+        if hasattr(self.inner, "evict"):
+            self.inner.evict(token)
+
+    def evict_subject(self, subject_id: str) -> None:
+        self.cache.evict_subject(subject_id)
+        if hasattr(self.inner, "evict_subject"):
+            self.inner.evict_subject(subject_id)
+
+    def cache_stats(self) -> dict:
+        return self.cache.stats()
+
+    def close(self) -> None:
+        if hasattr(self.inner, "close"):
+            self.inner.close()
 
 
 class StaticIdentityClient:
@@ -46,10 +241,14 @@ class GrpcIdentityClient:
     The subject payload travels as JSON bytes in ``SubjectResponse.payload``;
     transport errors and non-200 statuses resolve to ``payload: None`` so
     the engine's token path fails closed (unresolved subjects match no
-    role-gated rules)."""
+    role-gated rules).  Resolutions ride a ``TokenResolutionCache`` (TTL +
+    negative caching), so repeat tokens inside and across batches cost one
+    RPC per TTL window."""
 
     def __init__(self, address: str, timeout: float = 5.0,
-                 cache_size: int = 1024, logger=None):
+                 cache_size: int = 1024, logger=None,
+                 ttl_s: float = 600.0, negative_ttl_s: float = 30.0,
+                 counter=None):
         import grpc
 
         from .gen import access_control_pb2 as pb
@@ -64,29 +263,22 @@ class GrpcIdentityClient:
             request_serializer=pb.FindByTokenRequest.SerializeToString,
             response_deserializer=pb.SubjectResponse.FromString,
         )
-        # token -> resolved payload; evicted by the worker's userModified /
-        # auth-topic listeners exactly like the decision caches.  gRPC
-        # handler threads hit this concurrently — all access goes through
-        # _cache_lock, and entries cross the boundary as copies so caller
-        # mutation can't corrupt future hits
-        self._cache: dict[str, Any] = {}
-        self._cache_size = cache_size
-        self._cache_lock = threading.Lock()
-        # bumped by evict(): an in-flight resolution that began before an
-        # eviction must not re-insert its (possibly stale) payload after
-        self._cache_gen = 0
+        # token -> resolution envelope; TTL'd with negative caching, evicted
+        # by the worker's userModified/userDeleted listeners.  gRPC handler
+        # threads hit this concurrently — TokenResolutionCache is
+        # lock-guarded and its generation counter keeps an in-flight
+        # resolution from re-inserting a stale payload after an eviction.
+        self._cache = TokenResolutionCache(
+            ttl_s=ttl_s, negative_ttl_s=negative_ttl_s,
+            max_entries=cache_size, counter=counter,
+        )
 
     def find_by_token(self, token: str) -> Optional[dict]:
         import json
 
-        with self._cache_lock:
-            hit = self._cache.get(token)
-            gen = self._cache_gen
+        hit, gen = self._cache.lookup(token)
         if hit is not None:
-            # copy outside the lock: hits must not serialize on copy cost,
-            # but the cached entry still needs isolation from caller
-            # mutation
-            return copy.deepcopy(hit)
+            return hit
         try:
             resp = self._call(
                 self._pb.FindByTokenRequest(token=token),
@@ -97,6 +289,7 @@ class GrpcIdentityClient:
                 self.logger.warning(
                     "identity findByToken failed: %s", err
                 )
+            # 5xx: never cached, so recovery after an outage is immediate
             return {"payload": None,
                     "status": {"code": 503, "message": str(err)}}
         payload = None
@@ -110,27 +303,19 @@ class GrpcIdentityClient:
             "status": {"code": resp.status.code or 200,
                        "message": resp.status.message},
         }
-        if payload is not None:
-            entry = copy.deepcopy(out)
-            with self._cache_lock:
-                if self._cache_gen == gen and self._cache_size > 0:
-                    while (self._cache
-                           and len(self._cache) >= self._cache_size):
-                        self._cache.pop(next(iter(self._cache)))
-                    self._cache[token] = entry
-                # else: an evict() landed while this resolution was in
-                # flight — the payload may predate the user mutation that
-                # triggered it, so it must not repopulate the cache
+        self._cache.store(token, out, gen)
         return out
 
     def evict(self, token: str = None) -> None:
         """Drop cached resolutions (all, or one token) on user mutation."""
-        with self._cache_lock:
-            self._cache_gen += 1
-            if token is None:
-                self._cache.clear()
-            else:
-                self._cache.pop(token, None)
+        self._cache.evict(token)
+
+    def evict_subject(self, subject_id: str) -> None:
+        """Drop every cached resolution for one subject (userDeleted)."""
+        self._cache.evict_subject(subject_id)
+
+    def cache_stats(self) -> dict:
+        return self._cache.stats()
 
     def close(self) -> None:
         self.channel.close()

@@ -198,6 +198,10 @@ class Telemetry:
         self.decisions = Counter()
         self.paths = Counter()  # kernel / oracle / native-wire / cache-hit rows
         self.cache = Counter()  # decision-cache hits / misses / evictions
+        # token-resolution cache hits / misses / negative-hits / evictions
+        # (srv/identity.TokenResolutionCache — the host eligibility
+        # pipeline's identity-RPC amortizer)
+        self.identity = Counter()
         self.start_time = time.time()
 
     @contextmanager
@@ -223,6 +227,7 @@ class Telemetry:
             "decisions": self.decisions.snapshot(),
             "paths": self.paths.snapshot(),
             "decision_cache": self.cache.snapshot(),
+            "identity_cache": self.identity.snapshot(),
         }
 
 

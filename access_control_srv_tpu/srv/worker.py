@@ -154,6 +154,16 @@ class Worker:
                     ids_address,
                     timeout=float(cfg.get("client:identity:timeout", 5.0)),
                     logger=self.logger,
+                    cache_size=int(cfg.get(
+                        "client:identity:cache:max_entries", 1024
+                    )),
+                    ttl_s=float(cfg.get(
+                        "client:identity:cache:ttl_s", 600.0
+                    )),
+                    negative_ttl_s=float(cfg.get(
+                        "client:identity:cache:negative_ttl_s", 30.0
+                    )),
+                    counter=self.telemetry.identity,
                 )
             else:
                 self.identity_client = StaticIdentityClient()
@@ -380,6 +390,10 @@ class Worker:
                 self.hr_provider.evict_hr_scopes(user_id)
                 if self.decision_cache is not None:
                     self.decision_cache.evict_subject(user_id)
+                # the event carries no token list; the resolution cache
+                # indexes entries by payload subject id for exactly this
+                if hasattr(self.identity_client, "evict_subject"):
+                    self.identity_client.evict_subject(user_id)
         elif event_name == "userModified":
             user_id = (message or {}).get("id")
             if not user_id:
@@ -397,6 +411,10 @@ class Worker:
                     tok = token.get("token") if isinstance(token, dict) else token
                     if tok:
                         self.identity_client.evict(tok)
+            # ...and tokens the event does NOT list (rotated/expired ones
+            # the cache may still hold) drop via the subject-id index
+            if hasattr(self.identity_client, "evict_subject"):
+                self.identity_client.evict_subject(user_id)
             cached = self.subject_cache.get(f"cache:{user_id}:subject")
             if cached is None:
                 return

@@ -263,6 +263,101 @@ def _tree_with_conditions(rng: random.Random):
     return doc
 
 
+def test_token_and_context_query_fuzz():
+    """Host-pipeline fuzz (ISSUE 3): random condition trees with adapter
+    context queries sprinkled on ~half the condition rules, random
+    requests where ~half the subjects arrive as bare tokens — the full
+    evaluator path (batched resolution -> prefetch/fusion -> kernel/oracle
+    hybrid) must stay bit-identical to the oracle for every row, whatever
+    mix of fused, degraded and unresolved rows a round produces."""
+    from access_control_srv_tpu.srv.cache import HRScopeProvider, SubjectCache
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.identity import (
+        CachingIdentityClient,
+        StaticIdentityClient,
+    )
+
+    class StubAdapter:
+        def query(self, context_query, request):
+            # deterministic, filter-dependent result so fused rows and
+            # oracle re-pulls observe the same data
+            filters = getattr(context_query, "filters", None) or []
+            value = None
+            if filters:
+                from access_control_srv_tpu.core.common import get_field
+
+                value = get_field(filters[0], "value")
+            return [{"id": value or "id-0"}]
+
+    rng = random.Random(77001)
+    checked = fused = token_rows = 0
+    for round_ in range(6):
+        doc = _tree_with_conditions(rng)
+        for ps in doc["policy_sets"]:
+            for pol in ps["policies"]:
+                for rule in pol.get("rules") or []:
+                    if rule.get("condition") and rng.random() < 0.5:
+                        rule["context_query"] = {
+                            "filters": [{"field": "id", "operation": "eq",
+                                         "value": "id-0"}],
+                            "query": "query q { all { id } }",
+                        }
+        engine = AccessController()
+        for ps in load_policy_sets(doc):
+            engine.update_policy_set(ps)
+        compiled = compile_policies(engine.policy_sets, engine.urns)
+        if not compiled.supported:
+            continue
+        engine.resource_adapter = StubAdapter()
+
+        ids = StaticIdentityClient()
+        subject_cache = SubjectCache()
+        engine.identity_client = CachingIdentityClient(ids)
+        engine.hr_scope_provider = HRScopeProvider(subject_cache)
+
+        requests = _extended_requests(rng, 40)
+        for i, request in enumerate(requests):
+            if rng.random() >= 0.5:
+                continue
+            subject = request.context["subject"]
+            token = f"fuzz-tok-{round_}-{i}"
+            subject_id = subject.get("id") or f"anon-{i}"
+            ids.register(token, {
+                "id": subject_id,
+                "tokens": [{"token": token, "interactive": True}],
+                "role_associations": subject.get("role_associations"),
+            })
+            scopes = subject.get("hierarchical_scopes")
+            if scopes is not None:
+                subject_cache.set(f"cache:{subject_id}:hrScopes", scopes)
+            # occasional unresolvable token: must degrade, never diverge
+            request.context["subject"] = {
+                "token": token if rng.random() < 0.85 else f"bad-{token}"
+            }
+            token_rows += 1
+
+        expected = [engine.is_allowed(copy.deepcopy(r)) for r in requests]
+        ev = HybridEvaluator(engine)
+        responses = ev.is_allowed_batch([copy.deepcopy(r) for r in requests])
+        for b in range(len(requests)):
+            checked += 1
+            assert responses[b].decision == expected[b].decision, (
+                round_, b, responses[b].decision, expected[b].decision)
+            assert responses[b].operation_status.code == \
+                expected[b].operation_status.code, (round_, b)
+            assert responses[b].evaluation_cacheable == \
+                expected[b].evaluation_cacheable, (round_, b)
+        prepared = [copy.deepcopy(r) for r in requests]
+        ev.prepare_batch(prepared)
+        batch = encode_requests(
+            prepared, ev._compiled, engine.resource_adapter
+        )
+        fused += int(batch.eligible.sum())
+    assert checked >= 200
+    assert token_rows >= 80
+    assert fused >= 100  # the pipeline must actually keep rows on device
+
+
 def test_conditions_fuzz_through_evaluator():
     """Randomized trees WITH conditions through the full evaluator batch
     path: decisions, status codes AND operation_status messages (the

@@ -277,6 +277,116 @@ def test_read_pagination_and_sort(rig):
               dreq, rc_rb.DeleteResponse)
 
 
+# --------------------------------------------------------------------------
+# Golden wire-byte vectors (VERDICT weak item 7).  Everything above
+# round-trips through stubs generated from the SAME reconstructed protos, so
+# a field-number error in the reconstruction would pass every test and break
+# the first stock acs-client.  These vectors hand-encode the two
+# highest-risk messages — access_control.Request and access_control.Response,
+# the pair every rc decision call crosses the wire with — at the raw
+# tag/varint level, independent of any protobuf runtime.  If a regenerated
+# stub ever disagrees with these bytes, the field numbers moved.
+
+
+def _tag(field_no, wire_type=2):
+    """Proto wire tag byte(s): (field_no << 3) | wire_type, varint."""
+    return _varint((field_no << 3) | wire_type)
+
+
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _ld(field_no, payload):
+    """Length-delimited field (strings, bytes, sub-messages)."""
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return _tag(field_no, 2) + _varint(len(payload)) + payload
+
+
+def _attr(attr_id, value, nested=()):
+    """attribute.Attribute: id=1, value=2, attributes=3 (recursive)."""
+    out = _ld(1, attr_id) + _ld(2, value)
+    for sub in nested:
+        out += _ld(3, sub)
+    return out
+
+
+def test_request_golden_wire_bytes():
+    """access_control.Request: target=1 (rule.Target: subjects=1,
+    resources=2, actions=3 of attribute.Attribute) and context=2
+    (Context: subject=1 as google.protobuf.Any whose value=2 carries JSON
+    bytes — the reference unmarshals exactly that shape,
+    accessControlService.ts:103-125)."""
+    subject_json = b'{"id":"u1","role_associations":[]}'
+    golden = (
+        _ld(1,  # Request.target
+            _ld(1, _attr(URNS["role"], "admin-r-id"))          # subjects
+            + _ld(2, _attr(URNS["entity"], ORG))               # resources
+            + _ld(3, _attr(URNS["actionID"], URNS["read"])))   # actions
+        + _ld(2,  # Request.context
+              _ld(1,  # Context.subject: google.protobuf.Any
+                  _ld(2, subject_json)))  # Any.value (type_url unset)
+    )
+
+    msg = rc_ac.Request()
+    msg.target.subjects.add(id=URNS["role"], value="admin-r-id")
+    msg.target.resources.add(id=URNS["entity"], value=ORG)
+    msg.target.actions.add(id=URNS["actionID"], value=URNS["read"])
+    msg.context.subject.value = subject_json
+    assert msg.SerializeToString(deterministic=True) == golden
+
+    # and the stubs must parse the hand-encoded bytes back to the fields
+    parsed = rc_ac.Request.FromString(golden)
+    assert parsed.target.subjects[0].id == URNS["role"]
+    assert parsed.target.resources[0].value == ORG
+    assert parsed.target.actions[0].value == URNS["read"]
+    assert parsed.context.subject.value == subject_json
+
+
+def test_response_golden_wire_bytes():
+    """access_control.Response: decision=1 (enum varint), obligations=2
+    (attribute.Attribute incl. the nested attributes=3 the masked-property
+    obligations use), evaluation_cacheable=3 (bool varint),
+    operation_status=4 (status.OperationStatus: code=1, message=2).
+    DENY(1) keeps the enum on the wire (proto3 drops zero defaults)."""
+    prop = URNS["property"]
+    golden = (
+        _tag(1, 0) + _varint(1)  # decision = DENY
+        + _ld(2, _attr(  # obligations: masked-property shape
+            "urn:restorecommerce:acs:names:obligation:maskedProperty",
+            ORG,
+            nested=[_attr(prop, ORG + "#secret")]))
+        + _tag(3, 0) + _varint(1)  # evaluation_cacheable = true
+        + _ld(4, _tag(1, 0) + _varint(200) + _ld(2, "success"))
+    )
+
+    msg = rc_ac.Response()
+    msg.decision = rc_ac.Response.DENY
+    ob = msg.obligations.add(
+        id="urn:restorecommerce:acs:names:obligation:maskedProperty",
+        value=ORG,
+    )
+    ob.attributes.add(id=prop, value=ORG + "#secret")
+    msg.evaluation_cacheable = True
+    msg.operation_status.code = 200
+    msg.operation_status.message = "success"
+    assert msg.SerializeToString(deterministic=True) == golden
+
+    parsed = rc_ac.Response.FromString(golden)
+    assert parsed.decision == rc_ac.Response.DENY
+    assert parsed.obligations[0].attributes[0].value == ORG + "#secret"
+    assert parsed.evaluation_cacheable is True
+    assert parsed.operation_status.code == 200
+
+
 def test_policy_set_crud_under_reference_names(rig):
     from access_control_srv_tpu.srv.gen.rc import policy_set_pb2 as rc_ps
 

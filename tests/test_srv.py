@@ -414,11 +414,13 @@ class TestAdapterContextQuery:
             w.stop()
 
 
-    def test_context_query_rule_degrades_only_candidate_rows(self):
-        """VERDICT r2 item 6: one adapter-backed context-query rule must
-        not push the whole batch to the oracle — only rows whose resource
-        signature can reach that rule fall back; the rest stay on device
-        with exact pre-pass results."""
+    def test_context_query_rule_keeps_safe_candidate_rows_on_device(self):
+        """VERDICT r2 item 6 / r5 item 4: one adapter-backed context-query
+        rule must not push the whole batch to the oracle.  Non-candidate
+        rows keep exact pre-pass results, and candidate rows whose walk
+        provably never observes the reference's context merge get the
+        query PREFETCHED host-side and stay on device too
+        (ops/encode._prefetch_context_queries)."""
         import json
 
         def transport(url, body, headers):
@@ -492,11 +494,15 @@ class TestAdapterContextQuery:
                 [req(ORG), req(USER)], w.evaluator._compiled,
                 w.engine.resource_adapter,
             )
-            assert not batch.eligible[0]  # cq rule candidate: oracle row
+            # cq-rule candidate row: the query is prefetched host-side and
+            # the row rides the kernel (the merge provably stays invisible
+            # — no later candidate rule reads context on this signature)
+            assert batch.eligible[0]
             assert batch.eligible[1]      # plain row stays on device
+            assert not batch.ineligible_reasons
 
             responses = w.evaluator.is_allowed_batch([req(ORG), req(USER)])
-            assert responses[0].decision == Decision.PERMIT  # via adapter
+            assert responses[0].decision == Decision.PERMIT  # via prefetch
             assert responses[1].decision == Decision.PERMIT  # via kernel
         finally:
             w.stop()

@@ -197,6 +197,141 @@ def main() -> int:
     )
     results.append(row)
 
+    # 2c. host eligibility pipeline (token resolution + context-query
+    # prefetch, docs/ELIGIBILITY.md): must add ZERO new ops to any device
+    # program — host-only by construction.  Lower the dense program for a
+    # 100% token-bearing + context-query batch prepared through
+    # HybridEvaluator.prepare_batch and the prefetch pre-pass, and require
+    # it BYTE-identical to the program lowered for the same traffic
+    # arriving pre-resolved with no adapter configured: the pipeline may
+    # only change host-computed kernel INPUTS (resolved subject arrays,
+    # cond_true/cond_abort), never the program.
+    import copy
+
+    from access_control_srv_tpu.core.loader import load_policy_sets
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.identity import (
+        CachingIdentityClient,
+        StaticIdentityClient,
+    )
+
+    PO = ("urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:"
+          "permit-overrides")
+    cq_entity = "urn:restorecommerce:acs:model:auditcq.AuditCQ"
+    engine_tp = AccessController()
+    populate(engine_tp,
+             os.path.join(REPO, "tests", "fixtures", "role_scopes.yml"))
+    for ps in load_policy_sets({"policy_sets": [{
+        "id": "audit-cq", "combining_algorithm": PO, "policies": [{
+            "id": "audit-cqp", "combining_algorithm": PO, "rules": [{
+                "id": "audit-cqr",
+                "target": {"resources": [{"id": urns["entity"],
+                                          "value": cq_entity}],
+                           "actions": []},
+                "effect": "PERMIT",
+                "context_query": {
+                    "filters": [{"field": "id", "operation": "eq",
+                                 "value": "r1"}],
+                    "query": "query q { all { id } }",
+                },
+                "condition": "len(context._queryResult) > 0",
+            }],
+        }],
+    }]}):
+        engine_tp.update_policy_set(ps)
+    ids = StaticIdentityClient()
+    for i in range(8):
+        ids.register(f"tok-{i}", {
+            "id": f"user-{i}",
+            "tokens": [{"token": f"tok-{i}", "interactive": True}],
+            "role_associations": [
+                {"role": "superadministrator-r-id", "attributes": []}
+            ],
+        })
+    engine_tp.identity_client = CachingIdentityClient(ids)
+    from access_control_srv_tpu.srv.cache import HRScopeProvider, SubjectCache
+
+    subject_cache_tp = SubjectCache()
+    for i in range(8):
+        subject_cache_tp.set(f"cache:user-{i}:hrScopes", [])
+    engine_tp.hr_scope_provider = HRScopeProvider(subject_cache_tp)
+
+    class _AuditAdapter:
+        calls = 0
+
+        def query(self, context_query, request):
+            _AuditAdapter.calls += 1
+            return [{"id": "r1"}]
+
+    engine_tp.resource_adapter = _AuditAdapter()
+
+    def tp_request(i, subject):
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"],
+                                    value="superadministrator-r-id"),
+                          Attribute(id=urns["subjectID"],
+                                    value=f"user-{i}")],
+                resources=[Attribute(
+                    id=urns["entity"],
+                    value=cq_entity if i % 2 else
+                    "urn:restorecommerce:acs:model:organization"
+                    ".Organization",
+                ), Attribute(id=urns["resourceID"], value=f"res-{i}")],
+                actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+            ),
+            context={"resources": [], "subject": subject},
+        )
+
+    # variant A: bare tokens + adapter, through the pipeline
+    reqs_tok = [tp_request(i, {"token": f"tok-{i}"}) for i in range(8)]
+    compiled_tp = compile_policies(engine_tp.policy_sets, engine_tp.urns)
+    hybrid_tp = HybridEvaluator(engine_tp)
+    hybrid_tp.prepare_batch(reqs_tok)
+    batch_tok = encode_requests(reqs_tok, compiled_tp,
+                                engine_tp.resource_adapter)
+    # variant B: the same traffic pre-resolved, no adapter in play
+    def plain_subject(i):
+        subject = copy.deepcopy(ids.find_by_token(f"tok-{i}")["payload"])
+        subject["hierarchical_scopes"] = []
+        return subject
+
+    reqs_plain = [tp_request(i, plain_subject(i)) for i in range(8)]
+    batch_plain = encode_requests(reqs_plain, compiled_tp)
+
+    def lower_dense(batch):
+        kern = DecisionKernel(compiled_tp)
+        kern.evaluate(batch)  # smoke: real dispatch on this backend
+        _, bk, ebk, padl = lead_padding(batch)
+        largs = (
+            {k: jnp.asarray(padl(v)) for k, v in batch.arrays.items()},
+            jnp.asarray(pad_cols(batch.rgx_set, ebk)),
+            jnp.asarray(pad_cols(batch.pfx_neq, ebk)),
+            jnp.asarray(pad_cols(batch.cond_true, bk)),
+            jnp.asarray(pad_cols(batch.cond_abort, bk)),
+            jnp.asarray(pad_cols(batch.cond_code, bk)),
+        )
+        return jax.jit(lambda *a: kern._run_acl(*a)).lower(*largs).as_text()
+
+    hlo_tok = lower_dense(batch_tok)
+    hlo_plain = lower_dense(batch_plain)
+    pipeline_ok = (
+        bool(batch_tok.eligible.all())       # every token/cq row on device
+        and not batch_tok.ineligible_reasons
+        and _AuditAdapter.calls >= 4         # the cq rows were prefetched
+        and hlo_tok == hlo_plain             # zero new device ops
+    )
+    results.append({
+        "kernel": "token-prefetch-pipeline",
+        "ok": pipeline_ok,
+        "eligible_rows": int(batch_tok.eligible.sum()),
+        "hlo_identical": hlo_tok == hlo_plain,
+        "note": ("host eligibility pipeline (token resolution + context-"
+                 "query prefetch) lowers to the BYTE-identical device "
+                 "program as pre-resolved traffic — host-only by "
+                 "construction"),
+    })
+
     # 3. reverse-query kernel: capture the signature-planes runner the
     # same way (the per-row side is host numpy by design — ops/reverse.py)
     rq = ReverseQueryKernel(compiled, engine.policy_sets)
