@@ -61,6 +61,10 @@ class CompiledCondition:
     rule_flat_index: int
     condition: str
     context_query: Optional[object] = None
+    # identity path of the owning rule: ("rule", set_id, pol_key, rule_key)
+    # — lets the delta patcher (ops/delta.py) re-home flat indices without
+    # re-deriving ownership from the tree
+    owner: Optional[tuple] = None
 
 
 @dataclass
@@ -78,6 +82,10 @@ class CompiledPolicies:
     KR: int = 0
     T: int = 0
     version: int = 0
+    # node identity -> target-table row, recorded during lowering
+    # (("set", sid) / ("pol", sid, pkey) / ("rule", sid, pkey, rkey)):
+    # the delta patcher's stable-slot map for in-place row rewrites
+    target_owners: dict = field(default_factory=dict)
 
     @property
     def n_rules(self) -> int:
@@ -92,6 +100,126 @@ def _pad(values: list[int], width: int) -> list[int]:
     return (values + [ABSENT] * width)[:width]
 
 
+# target-table column name -> (row dict key, numpy dtype); one source of
+# truth shared by _TargetTable.to_arrays and the delta patcher's in-place
+# row writer (ops/delta.py)
+TARGET_COLUMNS: list[tuple[str, str, type]] = [
+    ("t_n_subjects", "n_subjects", np.int32),
+    ("t_role", "role", np.int32),
+    ("t_has_role", "has_role", bool),
+    ("t_scoping", "scoping", np.int32),
+    ("t_has_scoping", "has_scoping", bool),
+    ("t_hr_check", "hr_check", bool),
+    ("t_skip_acl", "skip_acl", bool),
+    ("t_sub_ids", "sub_ids", np.int32),
+    ("t_sub_vals", "sub_vals", np.int32),
+    ("t_act_ids", "act_ids", np.int32),
+    ("t_act_vals", "act_vals", np.int32),
+    ("t_ent_vals", "ent_vals", np.int32),
+    ("t_ent_w", "ent_w", np.int32),
+    ("t_ent_tails", "ent_tails", np.int32),
+    ("t_op_vals", "op_vals", np.int32),
+    ("t_prop_vals", "prop_vals", np.int32),
+    ("t_prop_sfx", "prop_sfx", np.int32),
+    ("t_has_props", "has_props", bool),
+    ("t_n_res", "n_res", np.int32),
+]
+
+
+def lower_target(
+    target: Optional[Target],
+    interner: StringInterner,
+    urns: Urns,
+    vocab_row,
+) -> tuple[dict, Optional[str]]:
+    """Lower ONE target into its row dict (the closed-form per-row
+    representation the kernel gathers from).  ``vocab_row(value) -> int``
+    allocates/looks up the entity regex-vocab row — the fresh compiler
+    appends, the delta patcher allocates inside a fixed capacity.
+
+    Returns (row, unsupported_reason_or_None); shared by the from-scratch
+    compile below and the in-place set relowering in ops/delta.py so the
+    two paths are bit-identical by construction."""
+    it = interner.intern
+    row: dict = {}
+    t = target or Target()
+    unsupported: Optional[str] = None
+
+    role_urn = urns.get("role")
+    scoping_urn = urns.get("roleScopingEntity")
+    skip_acl_urn = urns.get("skipACL")
+    hr_urn = urns.get("hierarchicalRoleScoping")
+    entity_urn = urns.get("entity")
+    property_urn = urns.get("property")
+    operation_urn = urns.get("operation")
+
+    role = None
+    scoping = None
+    hr_check = "true"
+    skip_acl = False
+    sub_pairs = []
+    for a in t.subjects or []:
+        sub_pairs.append((it(a.id), it(a.value)))
+        if a.id == role_urn:
+            role = a.value
+        elif a.id == hr_urn:
+            hr_check = a.value
+        elif a.id == scoping_urn:
+            scoping = a.value
+        if a.id == skip_acl_urn:
+            skip_acl = True
+
+    act_pairs = [(it(a.id), it(a.value)) for a in (t.actions or [])]
+
+    ent_vals, op_vals, prop_vals = [], [], []
+    for a in t.resources or []:
+        if a.id == entity_urn:
+            ent_vals.append(a.value)
+        elif a.id == operation_urn:
+            op_vals.append(a.value)
+        elif a.id == property_urn:
+            prop_vals.append(a.value)
+        # other resource attribute ids never match anything in the
+        # reference matcher; they only affect nothing (ref :492-576)
+
+    if len(sub_pairs) > K_SUB or len(act_pairs) > K_ACT:
+        unsupported = "subject/action attribute count exceeds caps"
+    if len(ent_vals) > K_ENT or len(op_vals) > K_OP or len(prop_vals) > K_PROP:
+        unsupported = "resource attribute count exceeds caps"
+    for v in ent_vals:
+        try:
+            re.compile(v[v.rfind(":") + 1:].split(".")[-1])
+        except re.error:
+            unsupported = f"invalid regex in entity value {v!r}"
+    if len(ent_vals) > 1 and prop_vals:
+        # requestEntityURN ambiguity: multiple entities + properties mix
+        # per-attribute state the closed form cannot represent
+        unsupported = "target mixes multiple entities with properties"
+
+    ent_ids = [it(v) for v in ent_vals]
+    row["n_subjects"] = len(t.subjects or [])
+    row["role"] = it(role) if role is not None else ABSENT
+    row["has_role"] = role is not None
+    row["scoping"] = it(scoping) if scoping is not None else ABSENT
+    row["has_scoping"] = scoping is not None
+    row["hr_check"] = hr_check == "true"
+    row["skip_acl"] = skip_acl
+    row["sub_ids"] = _pad([p[0] for p in sub_pairs], K_SUB)
+    row["sub_vals"] = _pad([p[1] for p in sub_pairs], K_SUB)
+    row["act_ids"] = _pad([p[0] for p in act_pairs], K_ACT)
+    row["act_vals"] = _pad([p[1] for p in act_pairs], K_ACT)
+    row["ent_vals"] = _pad(ent_ids, K_ENT)
+    row["ent_w"] = _pad([vocab_row(v) for v in ent_vals], K_ENT)
+    row["ent_tails"] = _pad([interner.tail_id[i] for i in ent_ids], K_ENT)
+    row["op_vals"] = _pad([it(v) for v in op_vals], K_OP)
+    prop_ids = [it(v) for v in prop_vals]
+    row["prop_vals"] = _pad(prop_ids, K_PROP)
+    row["prop_sfx"] = _pad([interner.suffix_id[i] for i in prop_ids], K_PROP)
+    row["has_props"] = len(prop_vals) > 0
+    row["n_res"] = len(t.resources or [])
+    return row, unsupported
+
+
 class _TargetTable:
     def __init__(self, interner: StringInterner, urns: Urns):
         self.interner = interner
@@ -100,6 +228,7 @@ class _TargetTable:
         self.entity_vocab: list[str] = []
         self.entity_vocab_ids: dict[int, int] = {}
         self.unsupported: Optional[str] = None
+        self.owners: dict[tuple, int] = {}
 
     def _vocab_row(self, value: str) -> int:
         vid = self.interner.intern(value)
@@ -110,113 +239,124 @@ class _TargetTable:
             self.entity_vocab_ids[vid] = row
         return row
 
-    def add(self, target: Optional[Target]) -> int:
+    def add(self, target: Optional[Target], owner: Optional[tuple] = None) -> int:
         """Lower a target into a row; returns the row index."""
-        urns = self.urns
-        it = self.interner.intern
-        row: dict = {}
-        t = target or Target()
-
-        role_urn = urns.get("role")
-        scoping_urn = urns.get("roleScopingEntity")
-        skip_acl_urn = urns.get("skipACL")
-        hr_urn = urns.get("hierarchicalRoleScoping")
-        entity_urn = urns.get("entity")
-        property_urn = urns.get("property")
-        operation_urn = urns.get("operation")
-
-        role = None
-        scoping = None
-        hr_check = "true"
-        skip_acl = False
-        sub_pairs = []
-        for a in t.subjects or []:
-            sub_pairs.append((it(a.id), it(a.value)))
-            if a.id == role_urn:
-                role = a.value
-            elif a.id == hr_urn:
-                hr_check = a.value
-            elif a.id == scoping_urn:
-                scoping = a.value
-            if a.id == skip_acl_urn:
-                skip_acl = True
-
-        act_pairs = [(it(a.id), it(a.value)) for a in (t.actions or [])]
-
-        ent_vals, op_vals, prop_vals = [], [], []
-        for a in t.resources or []:
-            if a.id == entity_urn:
-                ent_vals.append(a.value)
-            elif a.id == operation_urn:
-                op_vals.append(a.value)
-            elif a.id == property_urn:
-                prop_vals.append(a.value)
-            # other resource attribute ids never match anything in the
-            # reference matcher; they only affect nothing (ref :492-576)
-
-        if len(sub_pairs) > K_SUB or len(act_pairs) > K_ACT:
-            self.unsupported = "subject/action attribute count exceeds caps"
-        if len(ent_vals) > K_ENT or len(op_vals) > K_OP or len(prop_vals) > K_PROP:
-            self.unsupported = "resource attribute count exceeds caps"
-        for v in ent_vals:
-            try:
-                re.compile(v[v.rfind(":") + 1:].split(".")[-1])
-            except re.error:
-                self.unsupported = f"invalid regex in entity value {v!r}"
-        if len(ent_vals) > 1 and prop_vals:
-            # requestEntityURN ambiguity: multiple entities + properties mix
-            # per-attribute state the closed form cannot represent
-            self.unsupported = "target mixes multiple entities with properties"
-
-        ent_ids = [it(v) for v in ent_vals]
-        row["n_subjects"] = len(t.subjects or [])
-        row["role"] = it(role) if role is not None else ABSENT
-        row["has_role"] = role is not None
-        row["scoping"] = it(scoping) if scoping is not None else ABSENT
-        row["has_scoping"] = scoping is not None
-        row["hr_check"] = hr_check == "true"
-        row["skip_acl"] = skip_acl
-        row["sub_ids"] = _pad([p[0] for p in sub_pairs], K_SUB)
-        row["sub_vals"] = _pad([p[1] for p in sub_pairs], K_SUB)
-        row["act_ids"] = _pad([p[0] for p in act_pairs], K_ACT)
-        row["act_vals"] = _pad([p[1] for p in act_pairs], K_ACT)
-        row["ent_vals"] = _pad(ent_ids, K_ENT)
-        row["ent_w"] = _pad([self._vocab_row(v) for v in ent_vals], K_ENT)
-        row["ent_tails"] = _pad([self.interner.tail_id[i] for i in ent_ids], K_ENT)
-        row["op_vals"] = _pad([it(v) for v in op_vals], K_OP)
-        prop_ids = [it(v) for v in prop_vals]
-        row["prop_vals"] = _pad(prop_ids, K_PROP)
-        row["prop_sfx"] = _pad([self.interner.suffix_id[i] for i in prop_ids], K_PROP)
-        row["has_props"] = len(prop_vals) > 0
-        row["n_res"] = len(t.resources or [])
+        row, unsupported = lower_target(
+            target, self.interner, self.urns, self._vocab_row
+        )
+        if unsupported:
+            self.unsupported = unsupported
         self.rows.append(row)
-        return len(self.rows) - 1
+        idx = len(self.rows) - 1
+        if owner is not None:
+            self.owners[owner] = idx
+        return idx
+
+    def row_info(self, idx: int) -> tuple[bool, list[int]]:
+        """(has_props, padded entity value ids) of a lowered row — the
+        policy-level denormalized columns the set lowerer copies."""
+        row = self.rows[idx]
+        return row["has_props"], row["ent_vals"]
 
     def to_arrays(self) -> dict[str, np.ndarray]:
-        def col(name, dtype=np.int32):
-            return np.array([r[name] for r in self.rows], dtype=dtype)
-
         return {
-            "t_n_subjects": col("n_subjects"),
-            "t_role": col("role"),
-            "t_has_role": col("has_role", bool),
-            "t_scoping": col("scoping"),
-            "t_has_scoping": col("has_scoping", bool),
-            "t_hr_check": col("hr_check", bool),
-            "t_skip_acl": col("skip_acl", bool),
-            "t_sub_ids": col("sub_ids"),
-            "t_sub_vals": col("sub_vals"),
-            "t_act_ids": col("act_ids"),
-            "t_act_vals": col("act_vals"),
-            "t_ent_vals": col("ent_vals"),
-            "t_ent_w": col("ent_w"),
-            "t_ent_tails": col("ent_tails"),
-            "t_op_vals": col("op_vals"),
-            "t_prop_vals": col("prop_vals"),
-            "t_prop_sfx": col("prop_sfx"),
-            "t_has_props": col("has_props", bool),
-            "t_n_res": col("n_res"),
+            name: np.array([r[key] for r in self.rows], dtype=dtype)
+            for name, key, dtype in TARGET_COLUMNS
         }
+
+
+class _ConditionSink:
+    """Append-only condition registry for the from-scratch compile; the
+    delta patcher substitutes an identity-checked reuse sink
+    (ops/delta.py) so patched trees keep the condition list — and the
+    [C, B] device shapes derived from it — byte-stable."""
+
+    def __init__(self):
+        self.conditions: list[CompiledCondition] = []
+
+    def add(self, owner: tuple, flat_index: int, condition: str,
+            context_query) -> int:
+        idx = len(self.conditions)
+        self.conditions.append(
+            CompiledCondition(
+                rule_flat_index=flat_index,
+                condition=condition,
+                context_query=context_query,
+                owner=owner,
+            )
+        )
+        return idx
+
+
+def lower_set_into(a, s, ps, table, cond_sink, KP: int, KR: int
+                   ) -> Optional[str]:
+    """Lower ONE policy set into slot ``s`` of the padded arrays ``a``.
+
+    Factored out of compile_policies so the delta patcher (ops/delta.py)
+    can relower a mutated set in place — same loop, same write order, so
+    patched slots are value-identical to a from-scratch compile of the
+    same subtree.  ``table.add`` allocates/reuses target rows, ``cond_sink
+    .add`` allocates/reuses condition slots; returns the first unsupported
+    reason found at set/policy granularity (target-level reasons land on
+    ``table.unsupported``)."""
+    unsupported: Optional[str] = None
+    a["set_valid"][s] = True
+    ca = CA_CODES.get(ps.combining_algorithm, ABSENT)
+    a["set_ca"][s] = ca
+    if ps.target is not None:
+        a["set_has_target"][s] = True
+        a["set_target"][s] = table.add(ps.target, owner=("set", ps.id))
+    policies = list(ps.combinables.items())
+    if ca == ABSENT and any(p is not None for _, p in policies):
+        unsupported = f"unknown combining algorithm on set {ps.id!r}"
+    eff_ctx = 0  # carried-over policyEffect, per set
+    for kp, (pol_key, pol) in enumerate(policies):
+        if pol is None:
+            continue
+        a["pol_valid"][s, kp] = True
+        if pol.effect:
+            eff_ctx = EFFECT_CODES.get(pol.effect, 0)
+        a["pol_eff_ctx"][s, kp] = eff_ctx
+        a["pol_ca"][s, kp] = CA_CODES.get(pol.combining_algorithm, ABSENT)
+        a["pol_effect"][s, kp] = EFFECT_CODES.get(pol.effect, 0)
+        a["pol_cacheable"][s, kp] = bool(pol.evaluation_cacheable)
+        if pol.target is not None:
+            a["pol_has_target"][s, kp] = True
+            row_idx = table.add(pol.target, owner=("pol", ps.id, pol_key))
+            a["pol_target"][s, kp] = row_idx
+            a["pol_has_subjects"][s, kp] = bool(pol.target.subjects)
+            has_props, ent_vals = table.row_info(row_idx)
+            a["pol_has_props"][s, kp] = has_props
+            a["pol_ent_vals"][s, kp] = ent_vals
+        rules = list(pol.combinables.items())
+        a["pol_n_rules"][s, kp] = len(rules)
+        if a["pol_ca"][s, kp] == ABSENT and any(
+            r is not None for _, r in rules
+        ):
+            unsupported = f"unknown combining algorithm on policy {pol.id!r}"
+        cache_prefix = True
+        for kr, (rule_key, rule) in enumerate(rules):
+            if rule is None:
+                continue
+            a["rule_valid"][s, kp, kr] = True
+            a["rule_effect"][s, kp, kr] = EFFECT_CODES.get(rule.effect, 0)
+            raw = bool(rule.evaluation_cacheable)
+            a["rule_cacheable_raw"][s, kp, kr] = raw
+            cache_prefix = cache_prefix and raw
+            a["rule_cacheable_eff"][s, kp, kr] = raw and cache_prefix
+            if rule.target is not None:
+                a["rule_has_target"][s, kp, kr] = True
+                a["rule_target"][s, kp, kr] = table.add(
+                    rule.target, owner=("rule", ps.id, pol_key, rule_key)
+                )
+            if rule.condition:
+                a["rule_cond"][s, kp, kr] = cond_sink.add(
+                    ("rule", ps.id, pol_key, rule_key),
+                    (s * KP + kp) * KR + kr,
+                    rule.condition,
+                    rule.context_query,
+                )
+    return unsupported
 
 
 def compile_policies(
@@ -242,7 +382,7 @@ def compile_policies(
                 KR = max(KR, len(pol.combinables))
 
     unsupported: Optional[str] = None
-    conditions: list[CompiledCondition] = []
+    cond_sink = _ConditionSink()
 
     def zeros(dtype=np.int32, shape=None):
         return np.full(shape, ABSENT if dtype == np.int32 else False, dtype=dtype)
@@ -273,58 +413,9 @@ def compile_policies(
     }
 
     for s, ps in enumerate(sets):
-        a["set_valid"][s] = True
-        ca = CA_CODES.get(ps.combining_algorithm, ABSENT)
-        a["set_ca"][s] = ca
-        if ps.target is not None:
-            a["set_has_target"][s] = True
-            a["set_target"][s] = table.add(ps.target)
-        policies = list(ps.combinables.values())
-        if ca == ABSENT and any(p is not None for p in policies):
-            unsupported = f"unknown combining algorithm on set {ps.id!r}"
-        eff_ctx = 0  # carried-over policyEffect, per set
-        for kp, pol in enumerate(policies):
-            if pol is None:
-                continue
-            a["pol_valid"][s, kp] = True
-            if pol.effect:
-                eff_ctx = EFFECT_CODES.get(pol.effect, 0)
-            a["pol_eff_ctx"][s, kp] = eff_ctx
-            a["pol_ca"][s, kp] = CA_CODES.get(pol.combining_algorithm, ABSENT)
-            a["pol_effect"][s, kp] = EFFECT_CODES.get(pol.effect, 0)
-            a["pol_cacheable"][s, kp] = bool(pol.evaluation_cacheable)
-            if pol.target is not None:
-                a["pol_has_target"][s, kp] = True
-                a["pol_target"][s, kp] = table.add(pol.target)
-                a["pol_has_subjects"][s, kp] = bool(pol.target.subjects)
-                a["pol_has_props"][s, kp] = table.rows[-1]["has_props"]
-                a["pol_ent_vals"][s, kp] = table.rows[-1]["ent_vals"]
-            rules = list(pol.combinables.values())
-            a["pol_n_rules"][s, kp] = len(rules)
-            if a["pol_ca"][s, kp] == ABSENT and any(r is not None for r in rules):
-                unsupported = f"unknown combining algorithm on policy {pol.id!r}"
-            cache_prefix = True
-            for kr, rule in enumerate(rules):
-                if rule is None:
-                    continue
-                a["rule_valid"][s, kp, kr] = True
-                a["rule_effect"][s, kp, kr] = EFFECT_CODES.get(rule.effect, 0)
-                raw = bool(rule.evaluation_cacheable)
-                a["rule_cacheable_raw"][s, kp, kr] = raw
-                cache_prefix = cache_prefix and raw
-                a["rule_cacheable_eff"][s, kp, kr] = raw and cache_prefix
-                if rule.target is not None:
-                    a["rule_has_target"][s, kp, kr] = True
-                    a["rule_target"][s, kp, kr] = table.add(rule.target)
-                if rule.condition:
-                    a["rule_cond"][s, kp, kr] = len(conditions)
-                    conditions.append(
-                        CompiledCondition(
-                            rule_flat_index=(s * KP + kp) * KR + kr,
-                            condition=rule.condition,
-                            context_query=rule.context_query,
-                        )
-                    )
+        reason = lower_set_into(a, s, ps, table, cond_sink, KP, KR)
+        if reason:
+            unsupported = reason
 
     if not table.rows:
         table.add(None)
@@ -368,7 +459,7 @@ def compile_policies(
         interner=interner,
         urns=urns,
         arrays=arrays,
-        conditions=conditions,
+        conditions=cond_sink.conditions,
         entity_vocab=table.entity_vocab,
         entity_vocab_ids=table.entity_vocab_ids,
         supported=unsupported is None,
@@ -378,5 +469,6 @@ def compile_policies(
         KR=KR,
         T=len(table.rows),
         version=version,
+        target_owners=table.owners,
     )
     return compiled

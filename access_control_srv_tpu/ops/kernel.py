@@ -24,6 +24,7 @@ boolean algebra over padded tensors:
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -1004,24 +1005,42 @@ def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
 
 
 class DecisionKernel:
-    """Compiled-policy decision kernel with a jitted vmapped evaluate."""
+    """Compiled-policy decision kernel with a jitted vmapped evaluate.
 
-    def __init__(self, compiled: CompiledPolicies):
+    ``dynamic_policies=True`` (the hot-update mode, ops/delta.py) forces
+    the policy tables to enter jit as ARGUMENTS — never baked as XLA
+    constants — and registers the jitted callables in ``shared_jits`` so a
+    swapped-in kernel over patched tables with identical shapes reuses the
+    existing executables: an in-capacity policy mutation then costs zero
+    new XLA compilations."""
+
+    def __init__(self, compiled: CompiledPolicies,
+                 dynamic_policies: bool = False,
+                 shared_jits: Optional[dict] = None):
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
             )
         self.compiled = compiled
+        self.dynamic_policies = dynamic_policies
+        self._shared = shared_jits if shared_jits is not None else {}
         # hrv_role/hrv_scope stay host-side (encode's owner-bit packer
         # consumes them; the device programs read only packed bitplanes)
         self._c = {
             k: jnp.asarray(v) for k, v in compiled.arrays.items()
             if k not in ("hrv_role", "hrv_scope")
         }
-        self._bake_constants = bake_policy_constants(compiled)
+        self._bake_constants = (
+            not dynamic_policies and bake_policy_constants(compiled)
+        )
         with_hr = tree_needs_hr(compiled.arrays)
 
         def make_run(with_acl: bool):
+            key = ("dense", with_acl, with_hr)
+            if dynamic_policies and key in self._shared:
+                jitted = self._shared[key]
+                return lambda *args: jitted(self._c, *args)
+
             def run(c, batch_arrays, rgx_set, pfx_neq,
                     cond_true, cond_abort, cond_code):
                 # vmap over the leading batch axis of request arrays; regex
@@ -1041,6 +1060,8 @@ class DecisionKernel:
             if self._bake_constants:
                 return jax.jit(partial(run, self._c))
             jitted = jax.jit(run)
+            if dynamic_policies:
+                self._shared[key] = jitted
             return lambda *args: jitted(self._c, *args)
 
         # two compiled variants: batches without ACL pairs (the common

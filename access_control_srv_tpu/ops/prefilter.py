@@ -37,6 +37,7 @@ compaction nor host->device transfer of policy data again.
 from __future__ import annotations
 
 from dataclasses import replace
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -206,7 +207,8 @@ class PrefilteredKernel:
 
     def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024,
                  mesh=None, axis: str = "data", max_groups: int = 512,
-                 telemetry=None):
+                 telemetry=None, dynamic_policies: bool = False,
+                 shared_jits: Optional[dict] = None):
         """``mesh``: optional jax.sharding.Mesh — requests shard
         data-parallel over ``axis`` while the stacked subtrees and regex
         matrices replicate (the multi-chip layout of parallel/mesh.py
@@ -220,7 +222,15 @@ class PrefilteredKernel:
         arrays scale with G).
 
         ``telemetry``: optional srv.telemetry.Telemetry; counts signature
-        compaction/stack cache hits and misses and guard splits."""
+        compaction/stack cache hits and misses and guard splits.
+
+        ``dynamic_policies``: hot-update mode (ops/delta.py) — the group-
+        invariant policy metadata enters every jitted runner as an
+        ARGUMENT instead of a baked closure constant, and the jitted
+        callables live in ``shared_jits`` so a kernel swapped in over
+        patched tables with identical shapes reuses the compiled
+        executables (zero new XLA compilations per in-capacity
+        mutation)."""
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
@@ -231,6 +241,8 @@ class PrefilteredKernel:
         self.axis = axis
         self.max_groups = max_groups
         self.telemetry = telemetry
+        self.dynamic_policies = dynamic_policies
+        self._shared = shared_jits if shared_jits is not None else {}
         self._subs: dict[tuple, CompiledPolicies] = {}
         self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
         self._bits: dict[tuple, dict[str, jnp.ndarray]] = {}
@@ -256,7 +268,10 @@ class PrefilteredKernel:
 
                 self._dense = ShardedDecisionKernel(compiled, mesh, axis)
             else:
-                self._dense = DecisionKernel(compiled)
+                self._dense = DecisionKernel(
+                    compiled, dynamic_policies=dynamic_policies,
+                    shared_jits=self._shared,
+                )
         # hrv_role/hrv_scope are host-only since the owner-bitplane
         # rewrite (consumed by encode's packer, never by a device program)
         self._c_inv = {
@@ -268,10 +283,8 @@ class PrefilteredKernel:
         key = (with_acl, with_hr)
         run = self._runs.get(key)
         if run is None:
-            c_inv = self._c_inv  # baked as jit constants: [S,KP]-scale only
-
-            def run(cs, g_idx, batch_arrays, rgx_set, pfx_neq,
-                    cond_true, cond_abort, cond_code):
+            def body(c_inv, cs, g_idx, batch_arrays, rgx_set, pfx_neq,
+                     cond_true, cond_abort, cond_code):
                 def one(g, ra, ct, ca, cc):
                     # per-row gather of the group-VARYING arrays only;
                     # policy/set metadata is identical across subtrees
@@ -286,22 +299,45 @@ class PrefilteredKernel:
                     cond_true.T, cond_abort.T, cond_code.T,
                 )
 
-            if self.mesh is None:
-                run = jax.jit(run)
-            else:
+            shardings = None
+            if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 repl = NamedSharding(self.mesh, P())
                 data = NamedSharding(self.mesh, P(self.axis))
                 cond = NamedSharding(self.mesh, P(None, self.axis))
-                run = jax.jit(
-                    run,
-                    in_shardings=(repl, data, data, repl, repl,
-                                  cond, cond, cond),
-                    out_shardings=(data, data, data),
-                )
+                shardings = ((repl, data, data, repl, repl,
+                              cond, cond, cond), (data, data, data))
+            run = self._wrap_runner(("pref", key), body, shardings)
             self._runs[key] = run
         return run
+
+    def _wrap_runner(self, shared_key, body, shardings):
+        """Jit ``body(c_inv, *args)``.  Dynamic mode: c_inv is a real
+        argument and the jitted callable is shared across kernel swaps
+        (same shapes -> same executable, zero recompiles per patch).
+        Static mode: c_inv is baked as jit constants ([S,KP]-scale only),
+        exactly the pre-delta behavior."""
+        if not self.dynamic_policies:
+            from functools import partial
+
+            bound = partial(body, self._c_inv)
+            if shardings is None:
+                return jax.jit(bound)
+            return jax.jit(bound, in_shardings=shardings[0],
+                           out_shardings=shardings[1])
+        jitted = self._shared.get(shared_key)
+        if jitted is None:
+            if shardings is None:
+                jitted = jax.jit(body)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self.mesh, P())
+                jitted = jax.jit(body, in_shardings=(repl,) + shardings[0],
+                                 out_shardings=shardings[1])
+            self._shared[shared_key] = jitted
+        return lambda *args: jitted(self._c_inv, *args)
 
     def _sig_runner(self, schedule: tuple, needs_pairs: bool = True,
                     with_hr: bool = False):
@@ -329,8 +365,6 @@ class PrefilteredKernel:
         key = ("sig", schedule, needs_pairs, with_hr)
         run = self._runs.get(key)
         if run is None:
-            c_inv = self._c_inv
-
             def sub_fold(r, n_sub, has_role, role, sub_ids, sub_vals):
                 # checkSubjectMatches at plane granularity (reference:
                 # accessController.ts:793-823); broadcasts over the
@@ -367,7 +401,8 @@ class PrefilteredKernel:
                     pairs_ok = pairs_ok & ((sid < 0) | hit)
                 return (n_sub == 0) | jnp.where(has_role, role_ok, pairs_ok)
 
-            def run(cs, planes, slot_g, mega_rows, grid2row, gp_orig):
+            def body(c_inv, cs, planes, slot_g, mega_rows, grid2row,
+                     gp_orig):
                 # slot scatter/gather lives ON DEVICE: the compact [B, W]
                 # row buffer transfers once and a take() spreads it into
                 # the [NSLOT, R, W] grid (shipping the padded grid from
@@ -492,18 +527,14 @@ class PrefilteredKernel:
                 out_flat = out.transpose(0, 2, 1).reshape(NS * R, 3)
                 return jnp.take(out_flat, gp_orig, axis=0).T  # [3, B]
 
-            if self.mesh is None:
-                run = jax.jit(run)
-            else:
+            shardings = None
+            if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 repl = NamedSharding(self.mesh, P())
                 data = NamedSharding(self.mesh, P(self.axis))
-                run = jax.jit(
-                    run,
-                    in_shardings=(repl, repl, data, repl, data, repl),
-                    out_shardings=repl,
-                )
+                shardings = ((repl, repl, data, repl, data, repl), repl)
+            run = self._wrap_runner(key, body, shardings)
             self._runs[key] = run
         return run
 
@@ -567,10 +598,9 @@ class PrefilteredKernel:
                 "pfx_neq": p_pfx,
             }
             if self._bits_fn is None:
-                c_inv = self._c_inv
                 with_hr = self.needs_hr
 
-                def bits_fn(cs, rr):
+                def bits_fn(c_inv, cs, rr):
                     def one(g, r_row):
                         c = {**c_inv,
                              **jax.tree_util.tree_map(lambda x: x[g], cs)}
@@ -661,7 +691,9 @@ class PrefilteredKernel:
                     G = rr["r_ent_vals"].shape[0]
                     return jax.vmap(one)(jnp.arange(G), rr)
 
-                self._bits_fn = jax.jit(bits_fn)
+                self._bits_fn = self._wrap_runner(
+                    ("bits", self.needs_hr), bits_fn, None
+                )
             varying = {k: v for k, v in stacked.items()}
             bits = jax.tree_util.tree_map(
                 jnp.asarray,

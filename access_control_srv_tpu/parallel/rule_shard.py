@@ -246,7 +246,17 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
 class RuleShardedKernel:
     """Two-axis sharded kernel: requests over ``data``, rules over
     ``model``; per-shard compacted target subtables; ICI traffic is the
-    per-(set, policy) packed keys only."""
+    per-(set, policy) packed keys only.
+
+    Hot-update note: this kernel is NOT delta-patchable (ops/delta.py) —
+    ``partition_rules`` re-slices and re-compacts per shard, so a mutated
+    tree needs a fresh partition + device placement anyway.  The evaluator
+    therefore disables the incremental path whenever ``model_axis`` is
+    configured (srv/evaluator.py) and every mutation takes the
+    version-pinned full recompile; ``supports_delta`` makes the contract
+    explicit for callers probing kernels generically."""
+
+    supports_delta = False
 
     def __init__(self, compiled: CompiledPolicies, mesh: Mesh,
                  data_axis: str = "data", model_axis: str = "model"):

@@ -101,6 +101,12 @@ class CommandInterface:
             evaluator = self.service.evaluator
             if evaluator is not None:
                 detail["kernel_active"] = evaluator.kernel_active
+                if hasattr(evaluator, "delta_stats"):
+                    # incremental policy-update efficacy: patch vs
+                    # full-compile counts, fallback taxonomy, last
+                    # mutation-to-visibility latency and the active
+                    # capacity buckets (ops/delta.py)
+                    detail["policy_update"] = evaluator.delta_stats()
             decision_cache = self.decision_cache
             if decision_cache is None and evaluator is not None:
                 decision_cache = getattr(evaluator, "decision_cache", None)

@@ -31,6 +31,11 @@ DEFAULT_CONFIG: dict = {
         "backend": "hybrid",  # oracle | kernel | hybrid
         "micro_batch_window_ms": 2,
         "micro_batch_max": 4096,
+        # incremental policy updates (ops/delta.py): capacity-bucketed
+        # tables, in-place CRUD patching without XLA recompiles, scoped
+        # decision-cache invalidation.  Disable to force the pre-delta
+        # full-recompile + global-flush behavior on every mutation.
+        "delta_enabled": True,
     },
     "seed_data": None,
     "server": {"transports": [{"provider": "grpc", "addr": "0.0.0.0:50061"}]},

@@ -29,6 +29,17 @@ Design:
   ``config_update`` and pattern-less ``flush_cache`` bump a global epoch;
   entries written under an older epoch are logical misses (O(1) flush, no
   lock sweep on the mutation path).
+- **Scoped epoch bumps** — the incremental policy-update subsystem
+  (ops/delta.py) classifies each CRUD bump with a target-signature
+  *footprint*.  Entries store their request's resource features
+  (:func:`request_features`) at write time; an entry whose features are
+  disjoint from every bump between its epoch and the current one is
+  promoted in place instead of evicted, so sustained rule churn on entity
+  A keeps the warm set for entity B alive.  The PR-1 epoch-race invariant
+  holds verbatim on both paths: writers still snapshot the epoch BEFORE
+  the walk reads the tree, and ``put`` refuses whenever any intervening
+  bump (global, or scoped-and-affecting) could have changed the decision
+  — entries without features degrade to the pre-delta behavior exactly.
 
 The lookup path is host-only by construction: this module never imports
 jax and a cache hit returns before any encode or device dispatch
@@ -45,7 +56,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque, namedtuple
 from hashlib import blake2b
 from typing import Any, Optional
 
@@ -53,6 +64,52 @@ from ..core.common import get_field as _get
 from ..models.model import OperationStatus, Response
 
 _SEP = "\x1f"  # subject-id / digest separator inside keys
+
+# how many epoch bumps of footprint history to keep: entries older than
+# the log's reach are treated as globally flushed (conservative)
+_BUMP_LOG = 512
+
+# resource features of one request, matched against delta footprints
+# (ops/delta.RuleScope.affects): exact entity values, operation values and
+# action values of the request target
+RequestFeatures = namedtuple(
+    "RequestFeatures", ("entities", "ops", "actions")
+)
+
+
+def request_features(request, entity_urn: str, operation_urn: str
+                     ) -> Optional[RequestFeatures]:
+    """Candidate-signature features of an access request (the request-side
+    counterpart of ops/delta.scope_from_target); memoized on the request
+    object like the fingerprint.  None when the request has no target."""
+    memo = getattr(request, "_dc_features", None)
+    if memo is not None:
+        return memo
+    target = getattr(request, "target", None)
+    if target is None:
+        return None
+    ents, ops = [], []
+    for attr in _get(target, "resources") or []:
+        value = _get(attr, "value")
+        if value is None:
+            continue
+        attr_id = _get(attr, "id")
+        if attr_id == entity_urn:
+            ents.append(value)
+        elif attr_id == operation_urn:
+            ops.append(value)
+    acts = [
+        _get(attr, "value") for attr in _get(target, "actions") or []
+        if _get(attr, "value") is not None
+    ]
+    features = RequestFeatures(
+        frozenset(ents), frozenset(ops), frozenset(acts)
+    )
+    try:
+        request._dc_features = features
+    except Exception:  # exotic request objects
+        pass
+    return features
 
 
 def _canon(obj: Any) -> Any:
@@ -142,7 +199,8 @@ class _Shard:
     def __init__(self):
         self.lock = threading.Lock()
         # key -> (decision, obligations tuple, cacheable, code, message,
-        #         epoch, expires_at); OrderedDict order IS the LRU order
+        #         epoch, expires_at, features); OrderedDict order IS the
+        # LRU order
         self.entries: OrderedDict[str, tuple] = OrderedDict()
 
 
@@ -170,11 +228,16 @@ class DecisionCache:
         self._time = time_fn
         self.telemetry = telemetry
         self._epoch = 0
+        # (epoch, footprint-or-None) per bump, newest last; None = global.
+        # Bounded: anything older than the log is treated as global.
+        self._bumps: deque = deque(maxlen=_BUMP_LOG)
         self._stats_lock = threading.Lock()
         self._hits = 0
         self._misses = 0
         self._evictions = 0
         self._stores = 0
+        self._scoped_bumps = 0
+        self._scoped_survivors = 0
 
     # ---------------------------------------------------------------- stats
 
@@ -188,6 +251,8 @@ class DecisionCache:
         with self._stats_lock:
             hits, misses = self._hits, self._misses
             evictions, stores = self._evictions, self._stores
+            scoped_bumps = self._scoped_bumps
+            scoped_survivors = self._scoped_survivors
         lookups = hits + misses
         return {
             "enabled": self.enabled,
@@ -198,6 +263,8 @@ class DecisionCache:
             "hit_ratio": round(hits / lookups, 4) if lookups else None,
             "entries": sum(len(s.entries) for s in self._shards),
             "epoch": self._epoch,
+            "scoped_bumps": scoped_bumps,
+            "scoped_survivors": scoped_survivors,
             "ttl_s": self.ttl_s,
             "max_entries": self.max_entries,
             "shards": len(self._shards),
@@ -223,10 +290,42 @@ class DecisionCache:
         # needs
         return self._shards[hash(key) & self._mask]
 
+    def _affected_between(self, entry_epoch: int,
+                          features) -> bool:
+        """True when any epoch bump AFTER ``entry_epoch`` could have
+        changed a decision with these request features: global bumps
+        always count, scoped bumps count when their footprint intersects.
+        Feature-less entries (pre-delta callers) are affected by every
+        bump — identical to the original epoch semantics."""
+        current = self._epoch
+        if entry_epoch == current:
+            return False
+        if entry_epoch > current or features is None:
+            return True
+        with self._stats_lock:
+            bumps = list(self._bumps)
+        covered = current
+        for epoch, footprint in reversed(bumps):
+            if epoch <= entry_epoch:
+                break
+            covered = epoch
+            if footprint is None:
+                return True
+            try:
+                if footprint.affects(features):
+                    return True
+            except Exception:  # defensive: a broken footprint flushes
+                return True
+        # the log must reach back to entry_epoch + 1; older bumps were
+        # evicted from the bounded deque -> conservative global
+        return covered > entry_epoch + 1
+
     def get(self, key: Optional[str]) -> Optional[Response]:
         """Return a rebuilt Response for a live entry, else None.  Misses
         (absent, expired, stale-epoch) are counted; expired/stale entries
-        are collected in place."""
+        are collected in place.  Entries whose features are disjoint from
+        every intervening scoped bump survive (promoted to the current
+        epoch in place)."""
         if not self.enabled or key is None:
             return None
         shard = self._shard(key)
@@ -237,12 +336,22 @@ class DecisionCache:
             if entry is None:
                 self._count("misses")
                 return None
-            decision, obligations, cacheable, code, message, ent_epoch, exp = entry
-            if ent_epoch != epoch or exp <= now:
+            (decision, obligations, cacheable, code, message, ent_epoch,
+             exp, features) = entry
+            if exp <= now or (
+                ent_epoch != epoch
+                and self._affected_between(ent_epoch, features)
+            ):
                 del shard.entries[key]
                 self._count("evictions")
                 self._count("misses")
                 return None
+            if ent_epoch != epoch:
+                # scoped survivor: every bump since the entry was written
+                # is provably disjoint from its signature — re-stamp so
+                # later lookups take the fast path
+                shard.entries[key] = entry[:5] + (epoch, exp, features)
+                self._count("scoped_survivors")
             shard.entries.move_to_end(key)
         self._count("hits")
         # rebuild per hit: callers may hold the Response across a later
@@ -258,6 +367,7 @@ class DecisionCache:
     def put(
         self, key: Optional[str], response: Response,
         epoch: Optional[int] = None,
+        features=None,
     ) -> bool:
         """Write-through hook: stores only responses the engine marked
         ``evaluation_cacheable`` with a 200 status.  Returns True when
@@ -273,7 +383,14 @@ class DecisionCache:
         stale is refused outright rather than pushing a live LRU entry
         out.  ``None`` (direct/test callers whose compute did not span a
         mutation) stamps the current epoch, matching a snapshot taken
-        now."""
+        now.
+
+        ``features`` (:func:`request_features`) widens the acceptance: a
+        snapshot spanning only SCOPED bumps whose footprints are disjoint
+        from the request signature is provably still fresh (the mutation
+        could not have changed this decision) and is stored under the
+        current epoch.  Without features the pre-delta refusal applies
+        unchanged."""
         if not self.enabled or key is None or response is None:
             return False
         if response.evaluation_cacheable is not True:
@@ -283,7 +400,9 @@ class DecisionCache:
             return False
         ent_epoch = self._epoch if epoch is None else int(epoch)
         if ent_epoch != self._epoch:
-            return False
+            if self._affected_between(ent_epoch, features):
+                return False
+            ent_epoch = self._epoch  # disjoint scoped bumps only: fresh
         entry = (
             response.decision,
             tuple(response.obligations or ()),
@@ -292,6 +411,7 @@ class DecisionCache:
             status.message if status is not None else "success",
             ent_epoch,
             self._time() + self.ttl_s,
+            features,
         )
         shard = self._shard(key)
         with shard.lock:
@@ -309,8 +429,24 @@ class DecisionCache:
         """Logical full flush: policy-tree mutations (CRUD hot-sync,
         restore/reset/config_update) call this; stale entries become misses
         immediately and are collected lazily."""
+        return self._bump(None)
+
+    def bump_scoped(self, footprint) -> int:
+        """Scoped epoch bump (ops/delta.Footprint): entries and in-flight
+        writers whose request features are disjoint from ``footprint``
+        survive; everything else behaves exactly as a global bump.  A
+        global or empty-with-global footprint degrades to
+        :meth:`bump_epoch`."""
+        if footprint is None or getattr(footprint, "global_", True):
+            return self._bump(None)
+        epoch = self._bump(footprint)
+        self._count("scoped_bumps")
+        return epoch
+
+    def _bump(self, footprint) -> int:
         with self._stats_lock:
             self._epoch += 1
+            self._bumps.append((self._epoch, footprint))
             return self._epoch
 
     def flush(self) -> int:

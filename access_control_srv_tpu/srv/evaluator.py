@@ -12,21 +12,35 @@ old kernel keeps answering until the new compile (optionally off-thread)
 is swapped in atomically (the reference just mutates Maps in place,
 reference: src/core/accessController.ts:897-937 — we must not stall
 serving on an XLA compile).
+
+With the incremental-update subsystem active (ops/delta.py, the default
+off the rule-sharded mesh path), compiled tables are capacity-bucketed
+and CRUD mutations arrive as captured events (srv/store.py): in-capacity
+deltas PATCH the host tables and swap a new kernel object that reuses the
+existing jitted executables (zero new XLA compilations, sub-ms
+time-to-visibility), scoped decision-cache bumps keep disjoint entries
+warm, and certified-empty diffs skip the flush and the compile entirely.
+Everything the delta prover cannot certify falls back to the full
+recompile below, whose async variant is debounced: at most one compile
+runs and at most one is pending regardless of the CRUD arrival rate.
 """
 
 from __future__ import annotations
 
 import copy
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from ..core.engine import AccessController
 from ..models.model import Decision, OperationStatus, Response
+from ..ops import delta as delta_mod
 from ..ops.compile import DECISION_NAMES, compile_policies
 from ..ops.encode import encode_requests
 from ..ops.kernel import DecisionKernel
+from .decision_cache import request_features
 
 
 class HybridEvaluator:
@@ -41,6 +55,7 @@ class HybridEvaluator:
         mesh_axis: str = "data",
         model_axis: str | None = None,
         decision_cache=None,
+        delta_enabled: bool = True,
     ):
         self.engine = engine
         self.backend = backend
@@ -79,88 +94,321 @@ class HybridEvaluator:
         self._cand: Optional[tuple] = None  # (tree ref, CandidateIndex)
         self._lock = threading.Lock()
         self._compile_thread: Optional[threading.Thread] = None
+        # incremental-update subsystem (ops/delta.py): capacity-bucketed
+        # tables + CRUD-event patching.  Disabled on the rule-sharded mesh
+        # path (RuleShardedKernel repartitions per compile) and for the
+        # oracle backend (nothing compiled to patch).
+        self.delta_enabled = bool(
+            delta_enabled and model_axis is None and backend != "oracle"
+        )
+        self._caps = None                   # delta_mod.Capacities
+        self._delta_state = None            # delta_mod.DeltaState
+        self._shared_jits: dict = {}        # jitted executables, swap-stable
+        self._delta_counts = {
+            "patches": 0, "full_compiles": 0, "noops": 0,
+            "recompiles_avoided": 0, "fallbacks": 0,
+        }
+        self._delta_fallback_reasons: dict[str, int] = {}
+        self._last_visibility_ms: Optional[float] = None
+        # async full-compile debounce: at most one compile running and at
+        # most one pending, however fast CRUD events arrive
+        self._compile_state_lock = threading.Lock()
+        self._compile_pending = False
+        self._shutdown = False
         self.refresh(wait=True)  # oracle backend builds only the index
 
     # ------------------------------------------------------------- lifecycle
 
-    def refresh(self, wait: bool = False) -> None:
+    def refresh(self, wait: bool = False, events=None,
+                footprint=None) -> None:
         """Recompile the policy tensors after a tree mutation; the previous
-        kernel serves until the swap."""
-        if self.decision_cache is not None:
-            # the tree changed (CRUD hot-sync / restore / reset / policy
-            # load): every cached decision is logically flushed BEFORE the
-            # new tree serves — a stale hit must never outlive the swap
-            self.decision_cache.bump_epoch()
+        kernel serves until the swap.
+
+        ``events`` (list of ops/delta.CrudEvent, captured by the store at
+        mutation time) enables the incremental path: certified-empty diffs
+        skip the cache flush and the compile; in-capacity deltas patch the
+        bucketed tables in place and reuse every jitted executable;
+        anything else falls back to the full recompile.  ``footprint``
+        (ops/delta.Footprint) scopes the post-swap decision-cache bump on
+        the patch path — the pre-swap bump is the store's (the paired
+        invariant of PR 1, preserved verbatim on both paths)."""
+        t0 = time.perf_counter()
         if self.backend == "oracle":
+            if self.decision_cache is not None:
+                if footprint is not None and footprint.empty:
+                    pass  # certified no-op: nothing to flush
+                elif footprint is not None:
+                    self.decision_cache.bump_scoped(footprint)
+                else:
+                    self.decision_cache.bump_epoch()
             # no compile, but the oracle walk still benefits from the
             # candidate index — in fact it is the mode where EVERY
             # request takes that walk
             self._cand = self._build_candidate_index()
             return
+
+        if events is not None and self._delta_ready():
+            if self._try_patch(events, footprint, t0):
+                return
+
+        # ------------------------------------------------ full recompile
+        if self.decision_cache is not None:
+            # the tree changed (CRUD hot-sync / restore / reset / policy
+            # load) and no delta certificate exists: every cached decision
+            # is logically flushed BEFORE the new tree serves — a stale
+            # hit must never outlive the swap
+            self.decision_cache.bump_epoch()
         with self._lock:
             self._version += 1
-            version = self._version
 
-        def compile_and_swap():
-            # snapshot FIRST, compile FROM the snapshot: the published
-            # (tree, arrays) pair is then consistent by construction — a
-            # hot mutation landing mid-compile bumps _version and this
-            # compile is dropped below, never pairing a mutated tree with
-            # stale index arrays (the reverse-query kernel assembles its
-            # trees from this snapshot)
-            tree_snapshot = copy.deepcopy(self.engine.policy_sets)
+        if self.async_compile and not wait:
+            # debounce: one running compile + at most one pending.  The
+            # worker loop recompiles from the LATEST version at each
+            # round, so a burst of N CRUD events costs at most two
+            # compiles (the in-flight one and one covering the rest).
+            with self._compile_state_lock:
+                if self._shutdown:
+                    return
+                self._compile_pending = True
+                thread = self._compile_thread
+                if thread is None or not thread.is_alive():
+                    thread = threading.Thread(
+                        target=self._compile_worker, daemon=True
+                    )
+                    self._compile_thread = thread
+                    thread.start()
+        else:
+            with self._lock:
+                version = self._version
+            self._compile_and_swap(version, t0)
+
+    # --------------------------------------------------- incremental path
+
+    def _delta_ready(self) -> bool:
+        """The patch path engages only when the PUBLISHED compile is the
+        latest version (no async full compile in flight — patching stale
+        tables would silently drop the in-flight mutation) and a supported
+        kernel + ownership state exist."""
+        if not self.delta_enabled:
+            return False
+        with self._lock:
+            return (
+                self._compiled is not None
+                and self._compiled.supported
+                and self._kernel is not None
+                and self._delta_state is not None
+                and self._compiled.version == self._version
+            )
+
+    def _try_patch(self, events, footprint, t0) -> bool:
+        """Apply a CRUD delta in place; True when the refresh is fully
+        handled (patch published or certified no-op), False to fall back
+        to the full recompile."""
+        with self._lock:
+            compiled = self._compiled
+            state = self._delta_state
+            claimed = self._version
+        tree = self.engine.policy_sets
+        try:
+            result, patched, new_state, stats = delta_mod.apply_events(
+                state, compiled, tree, events, self.engine.urns
+            )
+        except delta_mod.DeltaIneligible as err:
+            self._delta_counts["fallbacks"] += 1
+            self._delta_fallback_reasons[err.reason] = (
+                self._delta_fallback_reasons.get(err.reason, 0) + 1
+            )
+            self._count_delta("delta-fallback")
+            if self.logger:
+                self.logger.info(
+                    "delta ineligible; full recompile",
+                    extra={"reason": err.reason},
+                )
+            return False
+        except Exception:  # noqa: BLE001 — patching must never kill CRUD
+            if self.logger:
+                self.logger.exception("delta patch failed; full recompile")
+            return False
+
+        if result == "noop":
+            # nothing evaluation-relevant changed: keep the compiled
+            # tables, the kernel AND the decision cache; only the
+            # candidate index must track the new tree identity
+            cand = self._build_candidate_index()
+            with self._lock:
+                if self._version == claimed:
+                    self._cand = cand
+                    self._tree_snapshot = tree
+                    if new_state is not None:
+                        self._delta_state = new_state
+            self._delta_counts["noops"] += 1
+            self._count_delta("delta-noop")
+            return True
+
+        from ..ops.prefilter import PrefilteredKernel
+
+        kernel = PrefilteredKernel(
+            patched, mesh=self.mesh, axis=self.mesh_axis,
+            telemetry=self.telemetry, dynamic_policies=True,
+            shared_jits=self._shared_jits,
+        )
+        native_encoder = self._make_native_encoder(patched, kernel)
+        cand = self._build_candidate_index()
+        with self._lock:
+            if self._version != claimed:
+                return False  # a newer refresh superseded this patch
+            self._version += 1
+            patched.version = self._version
+            self._compiled = patched
+            self._kernel = kernel
+            self._rq_kernel = None
+            self._tree_snapshot = tree
+            self._native_encoder = native_encoder
+            self._cand = cand
+            self._delta_state = new_state
+        if self.decision_cache is not None:
+            # post-swap bump, scoped to the delta's footprint: entries
+            # whose signatures are disjoint survive the mutation (the
+            # pre-swap bump in store._load_locked used the same footprint)
+            if footprint is not None:
+                self.decision_cache.bump_scoped(footprint)
+            else:
+                self.decision_cache.bump_epoch()
+        visibility_ms = (time.perf_counter() - t0) * 1e3
+        self._last_visibility_ms = visibility_ms
+        self._delta_counts["patches"] += 1
+        self._delta_counts["recompiles_avoided"] += 1
+        self._count_delta("delta-patch")
+        if self.telemetry is not None:
+            self.telemetry.policy_update_latency.observe(
+                visibility_ms / 1e3
+            )
+            self.telemetry.delta.inc(
+                "sets_patched", int(stats.get("sets_patched", 0))
+            )
+        return True
+
+    def _count_delta(self, key: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.delta.inc(key)
+
+    def delta_stats(self) -> dict:
+        """health_check surface: patch vs full-compile counts, fallback
+        taxonomy, last time-to-visibility and the active capacities."""
+        out = {
+            "enabled": self.delta_enabled,
+            **self._delta_counts,
+            "fallback_reasons": dict(self._delta_fallback_reasons),
+            "last_visibility_ms": (
+                round(self._last_visibility_ms, 3)
+                if self._last_visibility_ms is not None else None
+            ),
+        }
+        caps = self._caps
+        if caps is not None:
+            out["capacities"] = caps.as_dict()
+        return out
+
+    # ------------------------------------------------------ full compile
+
+    def _compile_worker(self) -> None:
+        """Debounced async compile loop: drains pending requests one
+        compile at a time, always from the latest version."""
+        while True:
+            with self._compile_state_lock:
+                if not self._compile_pending or self._shutdown:
+                    self._compile_thread = None
+                    return
+                self._compile_pending = False
+            with self._lock:
+                version = self._version
+            try:
+                self._compile_and_swap(version, time.perf_counter())
+            except Exception:  # noqa: BLE001 — keep draining
+                if self.logger:
+                    self.logger.exception("async policy compile failed")
+
+    def _compile_and_swap(self, version: int, t0: float) -> None:
+        # snapshot FIRST, compile FROM the snapshot: the published
+        # (tree, arrays) pair is then consistent by construction — a
+        # hot mutation landing mid-compile bumps _version and this
+        # compile is dropped below, never pairing a mutated tree with
+        # stale index arrays (the reverse-query kernel assembles its
+        # trees from this snapshot)
+        tree_snapshot = copy.deepcopy(self.engine.policy_sets)
+        caps = state = None
+        if self.delta_enabled:
+            compiled, caps, state = delta_mod.full_bucketed_compile(
+                tree_snapshot, self.engine.urns, version=version,
+                prev_caps=self._caps,
+            )
+        else:
             compiled = compile_policies(
                 tree_snapshot, self.engine.urns, version=version
             )
-            kernel = None
-            if compiled.supported and compiled.n_rules > 0:
-                if self.model_axis is not None and self.mesh is not None:
-                    # rule-axis sharding (config: parallel:model_devices):
-                    # the compiled tensors partition over the model axis,
-                    # requests over the data axis.  Evaluator-level path
-                    # counters (kernel/oracle rows) still record via
-                    # _count_path; only PrefilteredKernel's internal
-                    # cache counters have no sharded equivalent.
-                    from ..parallel.rule_shard import RuleShardedKernel
+        kernel = None
+        if compiled.supported and compiled.n_rules > 0:
+            if self.model_axis is not None and self.mesh is not None:
+                # rule-axis sharding (config: parallel:model_devices):
+                # the compiled tensors partition over the model axis,
+                # requests over the data axis.  Evaluator-level path
+                # counters (kernel/oracle rows) still record via
+                # _count_path; only PrefilteredKernel's internal
+                # cache counters have no sharded equivalent.
+                from ..parallel.rule_shard import RuleShardedKernel
 
-                    kernel = RuleShardedKernel(
-                        compiled, self.mesh,
-                        data_axis=self.mesh_axis,
-                        model_axis=self.model_axis,
-                    )
-                else:
-                    # PrefilteredKernel is a drop-in DecisionKernel that
-                    # keeps per-request work O(matching rules) on large
-                    # trees and delegates to the dense kernel below
-                    # MIN_RULES
-                    from ..ops.prefilter import PrefilteredKernel
-
-                    kernel = PrefilteredKernel(
-                        compiled, mesh=self.mesh, axis=self.mesh_axis,
-                        telemetry=self.telemetry,
-                    )
-            native_encoder = self._make_native_encoder(compiled, kernel)
-            cand = self._build_candidate_index()
-            with self._lock:
-                if version >= self._version:  # drop stale compiles
-                    self._compiled = compiled
-                    self._kernel = kernel
-                    self._rq_kernel = None  # lazy: built on first wia batch
-                    self._tree_snapshot = tree_snapshot
-                    self._native_encoder = native_encoder
-                    self._cand = cand
-            if self.logger and not compiled.supported:
-                self.logger.warning(
-                    "policy tree not kernel-supported; serving from oracle",
-                    extra={"reason": compiled.unsupported_reason},
+                kernel = RuleShardedKernel(
+                    compiled, self.mesh,
+                    data_axis=self.mesh_axis,
+                    model_axis=self.model_axis,
                 )
+            else:
+                # PrefilteredKernel is a drop-in DecisionKernel that
+                # keeps per-request work O(matching rules) on large
+                # trees and delegates to the dense kernel below
+                # MIN_RULES
+                from ..ops.prefilter import PrefilteredKernel
 
-        if self.async_compile and not wait:
-            thread = threading.Thread(target=compile_and_swap, daemon=True)
-            thread.start()
-            self._compile_thread = thread
-        else:
-            compile_and_swap()
+                kernel = PrefilteredKernel(
+                    compiled, mesh=self.mesh, axis=self.mesh_axis,
+                    telemetry=self.telemetry,
+                    dynamic_policies=self.delta_enabled,
+                    shared_jits=self._shared_jits,
+                )
+        native_encoder = self._make_native_encoder(compiled, kernel)
+        cand = self._build_candidate_index()
+        with self._lock:
+            if version >= self._version:  # drop stale compiles
+                self._compiled = compiled
+                self._kernel = kernel
+                self._rq_kernel = None  # lazy: built on first wia batch
+                self._tree_snapshot = tree_snapshot
+                self._native_encoder = native_encoder
+                self._cand = cand
+                self._caps = caps
+                self._delta_state = state
+        self._delta_counts["full_compiles"] += 1
+        self._count_delta("full-compile")
+        self._last_visibility_ms = (time.perf_counter() - t0) * 1e3
+        if self.telemetry is not None:
+            self.telemetry.policy_update_latency.observe(
+                time.perf_counter() - t0
+            )
+        if self.logger and not compiled.supported:
+            self.logger.warning(
+                "policy tree not kernel-supported; serving from oracle",
+                extra={"reason": compiled.unsupported_reason},
+            )
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop the async compile loop and join its thread (worker
+        shutdown must not leak daemon compile threads mid-XLA-compile)."""
+        with self._compile_state_lock:
+            self._shutdown = True
+            self._compile_pending = False
+            thread = self._compile_thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
 
     def _build_candidate_index(self):
         """(live tree, CandidateIndex) for trees worth indexing, else
@@ -393,9 +641,18 @@ class HybridEvaluator:
                 self._count_path("cache-hit", 1)
                 return hit
             response = self._oracle_is_allowed(request)
-            cache.put(key, response, epoch=epoch)
+            cache.put(key, response, epoch=epoch,
+                      features=self._request_features(request))
             return response
         return self._oracle_is_allowed(request)
+
+    def _request_features(self, request):
+        """Candidate-signature features for scoped cache invalidation
+        (srv/decision_cache.request_features)."""
+        urns = self.engine.urns
+        return request_features(
+            request, urns.get("entity"), urns.get("operation")
+        )
 
     def _oracle_is_allowed(self, request) -> Response:
         """Oracle walk, candidate-filtered on large trees (skipped rules
@@ -511,7 +768,8 @@ class HybridEvaluator:
                 # write-through from BOTH serving paths: kernel rows and
                 # oracle-fallback rows land here alike; put() keeps only
                 # cacheable 200s
-                cache.put(keys[b], computed[j], epoch=epoch)
+                cache.put(keys[b], computed[j], epoch=epoch,
+                          features=self._request_features(requests[b]))
         return responses
 
     def _is_allowed_batch_uncached(self, requests: list) -> list[Response]:

@@ -33,6 +33,7 @@ from typing import Callable, Optional
 from ..core.engine import AccessController
 from ..core.loader import policy_from_dict, policy_set_from_dict, rule_from_dict
 from ..models.model import Decision
+from ..ops.delta import CrudEvent, footprint_from_events
 
 
 class Collection:
@@ -387,11 +388,16 @@ class ResourceService:
         if denied:
             return denied
         results = []
+        events = []
         for doc in items:
+            events.append(CrudEvent(
+                kind=self.kind, op="create", doc_id=doc["id"],
+                old_doc=self.collection.get(doc["id"]), new_doc=doc,
+            ))
             self.collection.upsert(doc)
             self._emit(f"{self.KIND_EVENT[self.kind]}Created", doc)
             results.append({"payload": doc, "status": _op_status()})
-        self.store.sync_after_mutation(self.kind, "create", items)
+        self.store.sync_after_mutation(self.kind, "create", items, events)
         return {"items": results, "operation_status": _op_status()}
 
     def update(self, items: list[dict], subject=None, ctx=None) -> dict:
@@ -400,17 +406,23 @@ class ResourceService:
         if denied:
             return denied
         results = []
+        events = []
         for doc in items:
-            if self.collection.get(doc["id"]) is None:
+            old_doc = self.collection.get(doc["id"])
+            if old_doc is None:
                 results.append(
                     {"payload": None,
                      "status": _op_status(404, f"{doc['id']} not found")}
                 )
                 continue
+            events.append(CrudEvent(
+                kind=self.kind, op="update", doc_id=doc["id"],
+                old_doc=old_doc, new_doc=doc,
+            ))
             self.collection.upsert(doc)
             self._emit(f"{self.KIND_EVENT[self.kind]}Modified", doc)
             results.append({"payload": doc, "status": _op_status()})
-        self.store.sync_after_mutation(self.kind, "update", items)
+        self.store.sync_after_mutation(self.kind, "update", items, events)
         return {"items": results, "operation_status": _op_status()}
 
     def upsert(self, items: list[dict], subject=None, ctx=None) -> dict:
@@ -419,11 +431,16 @@ class ResourceService:
         if denied:
             return denied
         results = []
+        events = []
         for doc in items:
+            events.append(CrudEvent(
+                kind=self.kind, op="upsert", doc_id=doc["id"],
+                old_doc=self.collection.get(doc["id"]), new_doc=doc,
+            ))
             self.collection.upsert(doc)
             self._emit(f"{self.KIND_EVENT[self.kind]}Modified", doc)
             results.append({"payload": doc, "status": _op_status()})
-        self.store.sync_after_mutation(self.kind, "upsert", items)
+        self.store.sync_after_mutation(self.kind, "upsert", items, events)
         return {"items": results, "operation_status": _op_status()}
 
     def super_upsert(self, items: list[dict], sync: bool = True) -> dict:
@@ -464,17 +481,25 @@ class ResourceService:
                 return denied
             self.collection.clear()
             self._emit(f"{self.KIND_EVENT[self.kind]}Deleted", {"collection": True})
-            self.store.sync_after_mutation(self.kind, "delete_all", [])
+            self.store.sync_after_mutation(
+                self.kind, "delete_all", [],
+                [CrudEvent(kind=self.kind, op="delete_all", doc_id="")],
+            )
             return {"operation_status": _op_status()}
         items = [{"id": i} for i in (ids or [])]
         items = self._create_metadata(items, "DELETE", subject)
         denied = self._authorize(items, "DELETE", subject, ctx)
         if denied:
             return denied
+        events = []
         for doc_id in ids or []:
+            events.append(CrudEvent(
+                kind=self.kind, op="delete", doc_id=doc_id,
+                old_doc=self.collection.get(doc_id), new_doc=None,
+            ))
             self.collection.delete(doc_id)
             self._emit(f"{self.KIND_EVENT[self.kind]}Deleted", {"id": doc_id})
-        self.store.sync_after_mutation(self.kind, "delete", items)
+        self.store.sync_after_mutation(self.kind, "delete", items, events)
         return {"operation_status": _op_status()}
 
 
@@ -528,16 +553,41 @@ class PolicyStore:
     def get_resource_service(self, kind: str) -> ResourceService:
         return self.services[kind]
 
-    def load(self) -> None:
+    def load(self, events=None) -> None:
         """Compose the 3-level tree from the flat collections and swap it
         into the engine (reference: PolicySetService.load).  The new tree is
         built aside and swapped in with one reference assignment so serving
         threads never observe a cleared or half-built tree; the whole
-        read-compose-swap is serialized under _load_lock (see __init__)."""
-        with self._load_lock:
-            self._load_locked()
+        read-compose-swap is serialized under _load_lock (see __init__).
 
-    def _load_locked(self) -> None:
+        ``events`` (list of ops/delta.CrudEvent) carries the CRUD diff
+        captured at mutation time: it scopes the decision-cache flush to
+        the delta's target-signature footprint, lets certified-empty diffs
+        skip the flush entirely, and enables the evaluator's in-place
+        table patching.  ``None`` (boot load, restore, reset, seed) keeps
+        the pre-delta global-flush + full-recompile behavior."""
+        with self._load_lock:
+            self._load_locked(events)
+
+    def _delta_footprint(self, events):
+        """Conservative affected-signature footprint of a CRUD event list
+        (ops/delta.footprint_from_events over the live collections); None
+        means "unknown" and degrades to the global flush."""
+        if events is None:
+            return None
+        try:
+            return footprint_from_events(
+                events,
+                self.engine.urns,
+                lambda kind, doc_id: self.collections[kind].get(doc_id),
+                lambda kind: self.collections[kind].all(),
+            )
+        except Exception:  # noqa: BLE001 — footprint is an optimization
+            if self.logger:
+                self.logger.exception("delta footprint failed; global flush")
+            return None
+
+    def _load_locked(self, events=None) -> None:
         rules = {d["id"]: rule_from_dict(d) for d in self.collections["rule"].all()}
         policies = {}
         for p_doc in self.collections["policy"].all():
@@ -562,6 +612,7 @@ class PolicyStore:
                 for i, p in enumerate(child_policies)
             }
             tree[policy_set.id] = policy_set
+        footprint = self._delta_footprint(events)
         decision_cache = getattr(self.evaluator, "decision_cache", None)
         if decision_cache is not None:
             # epoch-flush BEFORE the swap: between the new tree going live
@@ -571,19 +622,31 @@ class PolicyStore:
             # before their walk reads the tree (DecisionCache.put), the
             # pre+post bumps guarantee no evaluation that saw the OLD tree
             # can store an entry whose epoch survives: its snapshot
-            # predates at least the post-swap bump
-            decision_cache.bump_epoch()
+            # predates at least the post-swap bump.  With a delta
+            # footprint both bumps are SCOPED: entries (and in-flight
+            # writers) whose target signatures are provably disjoint from
+            # the mutation keep the same guarantee without the flush —
+            # and a certified-empty diff (no-op CRUD) skips them entirely.
+            if footprint is not None and footprint.empty:
+                pass
+            elif footprint is not None:
+                decision_cache.bump_scoped(footprint)
+            else:
+                decision_cache.bump_epoch()
         self.engine.replace_policy_sets(tree)
         if self.evaluator is not None:
-            self.evaluator.refresh()
+            self.evaluator.refresh(events=events, footprint=footprint)
 
-    def sync_after_mutation(self, kind: str, op: str, items: list[dict]) -> None:
+    def sync_after_mutation(self, kind: str, op: str, items: list[dict],
+                            events=None) -> None:
         """Hot-sync the in-memory tree after a CRUD mutation.  The
         reference does targeted Map surgery for creates/deletes and a full
         reload for updates/upserts (reference: resourceManager.ts:202-215,
         274, 305, 352-369); a full recompose keeps both paths consistent
-        here, then the evaluator recompiles."""
-        self.load()
+        here, then the evaluator applies the delta (in-capacity table
+        patch + scoped cache invalidation) or falls back to a full
+        recompile (ops/delta.py taxonomy)."""
+        self.load(events)
 
     def seed(self, policy_set_docs, policy_docs, rule_docs) -> None:
         """superUpsert seed loading (reference: src/worker.ts:200-242).
@@ -638,6 +701,11 @@ class PolicyReplicator:
         self._timer: Optional[threading.Timer] = None
         self._stopped = False
         self._applied = 0
+        # CRUD events captured per applied frame (old doc read before the
+        # upsert/delete): the debounced sync hands them to store.load so
+        # remote mutations get the same delta patch + scoped invalidation
+        # as local ones
+        self._pending_events: list = []
         self._topics = {
             self.store.services[kind].topic.name: kind
             for kind in ("rule", "policy", "policy_set")
@@ -663,6 +731,7 @@ class PolicyReplicator:
             return
         collection = self.store.collections[kind]
         try:
+            event: Optional[CrudEvent] = None
             if event_name.endswith("Created") or event_name.endswith(
                 "Modified"
             ):
@@ -672,11 +741,20 @@ class PolicyReplicator:
                     # otherwise poison every later store.load() on this
                     # worker (local CRUD included)
                     _VALIDATORS[kind](doc)
+                    event = CrudEvent(
+                        kind=kind, op="upsert", doc_id=doc["id"],
+                        old_doc=collection.get(doc["id"]), new_doc=doc,
+                    )
                     collection.upsert(doc)
             elif event_name.endswith("Deleted"):
                 if doc.get("collection"):
+                    event = CrudEvent(kind=kind, op="delete_all", doc_id="")
                     collection.clear()
                 elif doc.get("id"):
+                    event = CrudEvent(
+                        kind=kind, op="delete", doc_id=doc["id"],
+                        old_doc=collection.get(doc["id"]), new_doc=None,
+                    )
                     collection.delete(doc["id"])
             else:
                 return
@@ -688,14 +766,16 @@ class PolicyReplicator:
                 )
             return
         self._applied += 1
-        self._schedule_sync()
+        self._schedule_sync(event)
 
-    def _schedule_sync(self) -> None:
+    def _schedule_sync(self, event=None) -> None:
         # arm only when no sync is pending: the pending sync composes
         # from the live collections at fire time, so it covers every
         # frame applied before it runs — and a replay burst of N frames
         # costs one timer thread, not N
         with self._lock:
+            if event is not None:
+                self._pending_events.append(event)
             if self._stopped or self._timer is not None:
                 return
             self._timer = threading.Timer(self.debounce_s, self._sync)
@@ -705,8 +785,10 @@ class PolicyReplicator:
     def _sync(self) -> None:
         with self._lock:
             self._timer = None
+            events = self._pending_events
+            self._pending_events = []
         try:
-            self.store.load()
+            self.store.load(events or None)
         except Exception:  # noqa: BLE001
             if self.logger:
                 self.logger.exception("replication tree sync failed")

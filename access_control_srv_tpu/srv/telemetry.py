@@ -202,6 +202,11 @@ class Telemetry:
         # (srv/identity.TokenResolutionCache — the host eligibility
         # pipeline's identity-RPC amortizer)
         self.identity = Counter()
+        # incremental policy-update subsystem (ops/delta.py): delta-patch /
+        # full-compile / noop / fallback counts, and the mutation-to-
+        # visibility latency (CRUD call to kernel swap) per update
+        self.delta = Counter()
+        self.policy_update_latency = Histogram()
         self.start_time = time.time()
 
     @contextmanager
@@ -228,6 +233,10 @@ class Telemetry:
             "paths": self.paths.snapshot(),
             "decision_cache": self.cache.snapshot(),
             "identity_cache": self.identity.snapshot(),
+            "policy_update": {
+                **self.delta.snapshot(),
+                "latency": self.policy_update_latency.snapshot(),
+            },
         }
 
 

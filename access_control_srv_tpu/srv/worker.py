@@ -268,6 +268,7 @@ class Worker:
             mesh_axis=cfg.get("parallel:axis", "data"),
             model_axis=model_axis,
             decision_cache=self.decision_cache,
+            delta_enabled=bool(cfg.get("evaluator:delta_enabled", True)),
         )
 
         # policy store with self-authorization hook; the hook consults the
@@ -349,6 +350,10 @@ class Worker:
     def stop(self) -> None:
         if self.batcher is not None:
             self.batcher.stop()
+        if self.evaluator is not None:
+            # join the debounced async-compile worker instead of leaking a
+            # daemon thread mid-XLA-compile (srv/evaluator.shutdown)
+            self.evaluator.shutdown()
         if getattr(self, "replicator", None) is not None:
             self.replicator.stop()
         if getattr(self, "store", None) is not None:
@@ -376,10 +381,21 @@ class Worker:
             )
 
     def _crud_cache_listener(self, event_name: str, message, ctx: dict) -> None:
-        """Rule/Policy/PolicySet Created/Modified/Deleted -> decision-cache
-        epoch flush (tree mutations make every cached decision suspect)."""
-        if event_name.endswith(("Created", "Modified", "Deleted")):
-            self.decision_cache.bump_epoch()
+        """Rule/Policy/PolicySet Created/Modified/Deleted from REMOTE
+        workers -> decision-cache epoch flush (their tree mutations make
+        cached decisions suspect before the replicator's debounced sync
+        lands).  This worker's OWN frames are skipped: the local CRUD path
+        already bumped through store hot-sync — with a delta-scoped
+        footprint, which an unconditional global bump here would defeat."""
+        if not event_name.endswith(("Created", "Modified", "Deleted")):
+            return
+        if (
+            isinstance(message, dict)
+            and self.store is not None
+            and message.get("origin") == self.store.origin
+        ):
+            return
+        self.decision_cache.bump_epoch()
 
     def _user_listener(self, event_name: str, message, ctx: dict) -> None:
         """userModified / userDeleted -> subject-cache + decision-cache

@@ -1270,6 +1270,185 @@ def bench_adapter_mixed_warm():
     )
 
 
+# -------------------------------------------------- config: CRUD churn
+
+
+def _churn_docs(n_rules: int):
+    """Doc-level twin of _stress_engine so the CRUD services drive it:
+    deny-overrides set of permit-overrides policies, role/entity/action
+    targeted cacheable rules."""
+    from access_control_srv_tpu.models import Urns
+
+    urns = Urns()
+    n_policies = max(1, n_rules // 400)
+    per_policy = n_rules // n_policies
+    entities = [
+        f"urn:restorecommerce:acs:model:stress{k}.Stress{k}" for k in range(64)
+    ]
+    actions = [urns["read"], urns["modify"], urns["create"], urns["delete"]]
+    rules, policies = [], []
+    rid = 0
+    for p in range(n_policies):
+        ids = []
+        for q in range(per_policy):
+            entity = entities[(p * 31 + q) % len(entities)]
+            rules.append({
+                "id": f"r{rid}",
+                "target": {
+                    "subjects": [{"id": urns["role"],
+                                  "value": f"role-{rid % 97}"}],
+                    "resources": [{"id": urns["entity"], "value": entity}],
+                    "actions": [{"id": urns["actionID"],
+                                 "value": actions[rid % len(actions)]}],
+                },
+                "effect": "PERMIT" if rid % 3 else "DENY",
+                "evaluation_cacheable": True,
+            })
+            ids.append(f"r{rid}")
+            rid += 1
+        policies.append(
+            {"id": f"p{p}", "combining_algorithm": PO, "rules": ids}
+        )
+    sets_ = [{"id": "stress", "combining_algorithm": DO,
+              "policies": [p["id"] for p in policies]}]
+    return sets_, policies, rules, rid
+
+
+def _churn_requests(n: int, actual_rules: int):
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    actions = [urns["read"], urns["modify"], urns["create"], urns["delete"]]
+    out = []
+    for i in range(n):
+        rid = (i * 13) % actual_rules
+        role = f"role-{rid % 97}"
+        out.append(Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"], value=role),
+                          Attribute(id=urns["subjectID"],
+                                    value=f"u{i % 512}")],
+                resources=[Attribute(
+                    id=urns["entity"],
+                    value=f"urn:restorecommerce:acs:model:stress{rid % 64}"
+                          f".Stress{rid % 64}",
+                )],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=actions[rid % len(actions)])],
+            ),
+            context={"resources": [], "subject": {
+                "id": f"u{i % 512}",
+                "role_associations": [{"role": role, "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        ))
+    return out
+
+
+def _churn_run(n_rules: int, batch: int, n_mutations: int,
+               serves_per_mutation: int, delta_enabled: bool):
+    """One churn loop: serve cacheable traffic, interleave rule-effect
+    mutations, measure per-mutation time-to-visibility (CRUD call until a
+    probe decision reflects the new effect) plus decisions/sec and the
+    decision-cache hit ratio under churn."""
+    import statistics
+
+    from access_control_srv_tpu.core import AccessController
+    from access_control_srv_tpu.srv.decision_cache import DecisionCache
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.store import PolicyStore
+
+    sets_, policies, rules, actual = _churn_docs(n_rules)
+    engine = AccessController()
+    cache = DecisionCache()
+    evaluator = HybridEvaluator(
+        engine, decision_cache=cache, delta_enabled=delta_enabled
+    )
+    store = PolicyStore(engine, evaluator=evaluator)
+    store.seed(sets_, policies, rules)
+    svc = store.get_resource_service("rule")
+    requests = _churn_requests(batch, actual)
+    evaluator.is_allowed_batch(requests)  # warm kernel programs + cache
+    # warm the 1-row probe bucket too: TTV measures mutation cost, not
+    # the one-time traffic-shape compile a cold batch size pays anyway
+    evaluator.is_allowed_batch(requests[:1])
+
+    # mutations rotate over rules targeting a handful of entities, so
+    # scoped invalidation can keep the other entities' warm set alive
+    victims = [rules[i] for i in range(0, 4 * 31, 31)][:n_mutations] or \
+        [rules[0]]
+    ttvs = []
+    decisions = 0
+    flips = {}
+    t_begin = time.perf_counter()
+    for m in range(n_mutations):
+        for _ in range(serves_per_mutation):
+            evaluator.is_allowed_batch(requests)
+            decisions += batch
+        doc = dict(victims[m % len(victims)])
+        flip = not flips.get(doc["id"], False)
+        flips[doc["id"]] = flip
+        doc["effect"] = "DENY" if (doc["effect"] == "PERMIT") == flip \
+            else "PERMIT"
+        probe = _churn_requests(1, actual)[0]
+        probe.target.resources[0].value = \
+            doc["target"]["resources"][0]["value"]
+        probe.target.subjects[0].value = \
+            doc["target"]["subjects"][0]["value"]
+        probe.target.actions[0].value = doc["target"]["actions"][0]["value"]
+        t0 = time.perf_counter()
+        svc.update([doc])
+        evaluator.is_allowed_batch([probe])  # first post-swap decision
+        ttvs.append((time.perf_counter() - t0) * 1e3)
+        decisions += 1
+    elapsed = time.perf_counter() - t_begin
+    stats = cache.stats()
+    dstats = evaluator.delta_stats()
+    return {
+        "ttv_ms_p50": round(statistics.median(ttvs), 2),
+        "ttv_ms_p99": round(sorted(ttvs)[max(0, int(len(ttvs) * 0.99) - 1)],
+                            2),
+        "decisions_per_s": round(decisions / elapsed, 1),
+        "hit_ratio": stats["hit_ratio"],
+        "scoped_survivors": stats.get("scoped_survivors", 0),
+        "patches": dstats["patches"],
+        "full_compiles": dstats["full_compiles"],
+        "fallback_reasons": dstats["fallback_reasons"],
+    }
+
+
+def bench_crud_churn():
+    """Throughput-under-churn + time-to-visibility for the incremental
+    policy-update subsystem (ops/delta.py): the delta-patched path vs the
+    forced full-recompile path on the same tree and traffic.  Bar
+    (BASELINE.md): patched median TTV >= 5x lower; decision-cache hit
+    rate preserved for signatures disjoint from the churn."""
+    n_rules = int(os.environ.get("CHURN_RULES", 1000))
+    batch = int(os.environ.get("CHURN_BATCH", 256))
+    n_mut = int(os.environ.get("CHURN_MUTATIONS", 16))
+    n_mut_full = int(os.environ.get("CHURN_MUTATIONS_FULL", 5))
+    serves = int(os.environ.get("CHURN_SERVES_PER_MUTATION", 3))
+
+    patched = _churn_run(n_rules, batch, n_mut, serves, delta_enabled=True)
+    full = _churn_run(n_rules, batch, n_mut_full, serves,
+                      delta_enabled=False)
+    speedup = full["ttv_ms_p50"] / max(patched["ttv_ms_p50"], 1e-6)
+    return _result(
+        f"crud-churn time-to-visibility speedup, delta patch vs full "
+        f"recompile ({n_rules}-rule tree)",
+        speedup,
+        "x",
+        {
+            "rules": n_rules, "batch": batch,
+            "mutations_patched": n_mut, "mutations_full": n_mut_full,
+            "patched": patched, "full_recompile": full,
+            "bar": ">=5x lower median time-to-visibility at equal "
+                   "decision correctness (tests/test_delta.py "
+                   "differential)",
+        },
+    )
+
+
 HOST_ONLY = {"scalar", "wia"}
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -1278,7 +1457,7 @@ def main():
     which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
                              "hr-deep", "stress", "stress-hr", "serve",
                              "serve-latency", "token-mix", "adapter-mixed",
-                             "adapter-mixed-warm"]
+                             "adapter-mixed-warm", "crud-churn"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -1358,6 +1537,7 @@ def main():
         "token-mix": bench_token_mix,
         "adapter-mixed": bench_adapter_mixed,
         "adapter-mixed-warm": bench_adapter_mixed_warm,
+        "crud-churn": bench_crud_churn,
     }
     for name in which:
         row = fns[name]()
