@@ -47,6 +47,12 @@ def main(argv: list[str] | None = None) -> int:
         help="broker auth: shared secret required from every connection "
              "(also via ACS_BROKER_SECRET)",
     )
+    parser.add_argument(
+        "--broker-fsync-interval", default=None, type=float,
+        help="broker durability: fsync the journal at most every N "
+             "seconds (0 = every record); default keeps flush-only "
+             "semantics — a host crash can drop the flushed tail",
+    )
     args = parser.parse_args(argv)
 
     if args.addr is not None:
@@ -73,6 +79,7 @@ def main(argv: list[str] | None = None) -> int:
             host or "127.0.0.1", int(port),
             data_dir=args.broker_data_dir,
             secret=args.broker_secret,
+            fsync_interval_s=args.broker_fsync_interval,
         ).start()
         print(f"broker listening on {broker.address}", flush=True)
         stop_event.wait()

@@ -930,14 +930,19 @@ class AccessController:
 
     # ------------------------------------------------- context queries
 
-    def create_resource_adapter(self, adapter_config: dict) -> None:
-        """(reference: accessController.ts:943-951)"""
+    def create_resource_adapter(self, adapter_config: dict,
+                                breaker=None) -> None:
+        """(reference: accessController.ts:943-951); ``breaker`` is the
+        shared context-query circuit breaker when admission control is
+        active (srv/admission.py — wired by srv/worker.py)."""
         try:
             from ..srv.adapters import create_adapter
         except ImportError as exc:
             raise errors.UnsupportedResourceAdapter(adapter_config) from exc
 
-        self.resource_adapter = create_adapter(adapter_config, self.logger)
+        self.resource_adapter = create_adapter(
+            adapter_config, self.logger, breaker=breaker
+        )
 
     def pull_context_resources(self, context_query, request: Request):
         """Query the resource adapter and graft the result onto a merged

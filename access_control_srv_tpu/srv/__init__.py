@@ -3,6 +3,7 @@ interface, subject/HR-scope cache, micro-batching evaluator and the
 composition-root worker (reference: src/worker.ts, src/resourceManager.ts,
 src/accessControlService.ts)."""
 
+from .admission import AdmissionController, CircuitBreaker
 from .config import Config
 from .events import EventBus, Topic
 from .cache import SubjectCache, HRScopeProvider
@@ -25,6 +26,8 @@ from .command import CommandInterface
 from .worker import Worker
 
 __all__ = [
+    "AdmissionController",
+    "CircuitBreaker",
     "Config",
     "EventBus",
     "Topic",

@@ -40,10 +40,13 @@ from ..core.errors import (
 
 DEFAULT_TIMEOUT_S = 5.0
 DEFAULT_MAX_CONCURRENCY = 8
-# transient (5xx) transport failures are retried ONCE after a jittered
+# transient (5xx) transport failures are retried after a jittered
 # backoff before the row falls back to the slow path — a single blip at the
-# endpoint must not demote a whole batch slice to the oracle walk
+# endpoint must not demote a whole batch slice to the oracle walk.  Both
+# knobs are config-driven (adapter block: retry_count / retry_backoff_s);
+# the backoff doubles per attempt from the base.
 DEFAULT_RETRY_BACKOFF_S = 0.05
+DEFAULT_RETRY_COUNT = 1
 
 
 class ResourceAdapter:
@@ -137,6 +140,8 @@ class GraphQLAdapter(ResourceAdapter):
         max_concurrency: int | None = None,
         retry_transient: bool | None = None,
         retry_backoff_s: float | None = None,
+        retry_count: int | None = None,
+        breaker=None,
     ):
         self.url = url
         self.logger = logger
@@ -163,6 +168,16 @@ class GraphQLAdapter(ResourceAdapter):
             else self.client_opts.get("retry_backoff_s",
                                       DEFAULT_RETRY_BACKOFF_S)
         )
+        self.retry_count = int(
+            retry_count
+            if retry_count is not None
+            else self.client_opts.get("retry_count", DEFAULT_RETRY_COUNT)
+        )
+        # shared circuit breaker (srv/admission.CircuitBreaker): a down
+        # context-query upstream fails rows fast down the existing
+        # kernel -> retry -> oracle ladder instead of paying timeout_s
+        # per request
+        self.breaker = breaker
         self._pool: Optional[_ConnectionPool] = None
         self._pool_lock = threading.Lock()
         self.transport = transport or self._http_post
@@ -216,28 +231,77 @@ class GraphQLAdapter(ResourceAdapter):
             variables["filters"] = filters
         return variables
 
-    def _transport_with_retry(self, body: bytes, headers: dict) -> bytes:
-        """One jittered retry on a transient (5xx) transport failure before
-        the caller's deny/oracle degradation; 4xx responses and payload
-        errors are definitive and surface immediately."""
+    def _transport_once(self, body: bytes, headers: dict) -> bytes:
+        """One transport call under the circuit breaker: an open circuit
+        fails fast with a 503 transport error (no network wait), outcomes
+        feed the breaker's failure-rate window.  4xx responses are the
+        UPSTREAM answering (definitively) — they count as breaker
+        successes; 5xx and connection-level failures count as failures."""
+        breaker = self.breaker
+        if breaker is not None and not breaker.allow():
+            raise ContextQueryTransportError(
+                503, "context-query circuit open"
+            )
         try:
-            return self.transport(self.url, body, headers)
+            data = self.transport(self.url, body, headers)
         except ContextQueryTransportError as err:
-            code = getattr(err, "code", None)
-            if (
-                not self.retry_transient
-                or not isinstance(code, int)
-                or not 500 <= code < 600
-            ):
-                raise
-            delay = self.retry_backoff_s * (0.5 + random.random())
-            if self.logger:
-                self.logger.warning(
-                    "transient context-query failure (%s); retrying once "
-                    "in %.0f ms", code, delay * 1e3,
+            if breaker is not None:
+                code = getattr(err, "code", None)
+                if isinstance(code, int) and 400 <= code < 500:
+                    breaker.record_success()
+                else:
+                    breaker.record_failure()
+            raise
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return data
+
+    def _transport_with_retry(
+        self, body: bytes, headers: dict,
+        deadline: Optional[float] = None,
+    ) -> bytes:
+        """Up to ``retry_count`` jittered, exponentially-backed-off
+        retries on transient (5xx) transport failures before the caller's
+        deny/oracle degradation; 4xx responses and payload errors are
+        definitive and surface immediately.  Deadline-aware: a retry is
+        skipped when the row's remaining budget cannot cover the backoff
+        plus another transport timeout — the row goes straight to the
+        oracle fallback instead of blowing its deadline in a sleep."""
+        attempt = 0
+        while True:
+            try:
+                return self._transport_once(body, headers)
+            except ContextQueryTransportError as err:
+                code = getattr(err, "code", None)
+                if (
+                    not self.retry_transient
+                    or attempt >= self.retry_count
+                    or not isinstance(code, int)
+                    or not 500 <= code < 600
+                ):
+                    raise
+                delay = (
+                    self.retry_backoff_s * (2 ** attempt)
+                    * (0.5 + random.random())
                 )
-            time.sleep(delay)
-            return self.transport(self.url, body, headers)
+                if deadline is not None and (
+                    time.monotonic() + delay + self.timeout_s > deadline
+                ):
+                    # the remaining budget cannot cover backoff + another
+                    # attempt: surface the failure now
+                    raise
+                if self.logger:
+                    self.logger.warning(
+                        "transient context-query failure (%s); retry %d/%d "
+                        "in %.0f ms", code, attempt + 1, self.retry_count,
+                        delay * 1e3,
+                    )
+                time.sleep(delay)
+                attempt += 1
 
     def query(self, context_query, request) -> Any:
         gql_query = getattr(context_query, "query", "") or ""
@@ -245,7 +309,10 @@ class GraphQLAdapter(ResourceAdapter):
         body = json.dumps({"query": gql_query, "variables": variables}).encode()
         headers = {"Content-Type": "application/json"}
         headers.update(self.client_opts.get("headers", {}))
-        raw = self._transport_with_retry(body, headers)
+        raw = self._transport_with_retry(
+            body, headers,
+            deadline=getattr(request, "_deadline", None),
+        )
         try:
             payload = json.loads(raw)
         except (TypeError, ValueError) as exc:
@@ -289,7 +356,8 @@ class GraphQLAdapter(ResourceAdapter):
             return list(pool.map(one, pairs))
 
 
-def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
+def create_adapter(adapter_config: dict, logger=None,
+                   breaker=None) -> ResourceAdapter:
     """(reference: accessController.ts:943-951)"""
     if adapter_config and adapter_config.get("graphql"):
         opts = adapter_config["graphql"]
@@ -306,5 +374,9 @@ def create_adapter(adapter_config: dict, logger=None) -> ResourceAdapter:
             retry_backoff_s=adapter_config.get(
                 "retry_backoff_s", opts.get("retry_backoff_s")
             ),
+            retry_count=adapter_config.get(
+                "retry_count", opts.get("retry_count")
+            ),
+            breaker=breaker,
         )
     raise UnsupportedResourceAdapter(adapter_config)

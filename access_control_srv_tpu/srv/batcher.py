@@ -1,4 +1,5 @@
-"""Micro-batching frontend for isAllowed.
+"""Micro-batching frontend for isAllowed (and, under admission control,
+whatIsAllowed as the bulk traffic class).
 
 The reference evaluates one request per gRPC call
 (reference: src/accessControlService.ts:62-81); the TPU path earns its
@@ -14,7 +15,23 @@ the collector keeps collecting AND runs the host-side eligibility pipeline
 rendezvous) for batch i+1 — host RPC latency for the next batch overlaps
 device execution of the current one.  At most one batch is queued behind
 the one evaluating, so backpressure still reaches callers through their
-futures."""
+futures.
+
+Admission control (srv/admission.py, config ``admission`` block): with a
+controller wired, submits pass a bounded-queue + deadline-feasibility
+gate (shed -> fast INDETERMINATE with the overload status, never a
+fabricated decision), rows whose deadline expired while queued are
+dropped at dispatch, the collection cap adapts to the batch-latency EWMA,
+and a second BULK queue carries whatIsAllowed reverse queries with a
+fairness guarantee: under interactive saturation a bulk round still runs
+every ``bulk_interval`` interactive rounds, so neither class starves the
+other.  Without a controller (or with ``admission.enabled`` false) the
+serving path is byte-identical to the pre-admission behavior.
+
+Shutdown drains: ``stop()`` stops admitting, flushes already-admitted
+batches to completion bounded by a drain deadline, and resolves anything
+still queued with a distinct shutdown status instead of leaving caller
+futures hanging."""
 
 from __future__ import annotations
 
@@ -24,7 +41,15 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Optional
 
-from ..models.model import Request, Response
+from ..models.model import Request, Response, ReverseQuery
+from .admission import (
+    DEADLINE_CODE,
+    SHUTDOWN_CODE,
+    AdmissionController,
+    BULK,
+    INTERACTIVE,
+    overload_response,
+)
 
 
 class MicroBatcher:
@@ -34,42 +59,105 @@ class MicroBatcher:
         window_ms: float = 2.0,
         max_batch: int = 4096,
         min_kernel_batch: int = 8,
+        admission: Optional[AdmissionController] = None,
     ):
         self.evaluator = evaluator
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self.min_kernel_batch = min_kernel_batch
-        self._queue: "queue.Queue[tuple[Request, Future]]" = queue.Queue()
+        self.admission = admission
+        # queue items are (request, future, deadline) — deadline is an
+        # absolute monotonic instant or None
+        self._queue: "queue.Queue[tuple[Request, Future, Optional[float]]]" \
+            = queue.Queue()
+        self._bulk: "queue.Queue[tuple[Request, Future, Optional[float]]]" \
+            = queue.Queue()
         self._stop = threading.Event()
+        self._stopping = False  # set before _stop: submits shed immediately
+        self._drain_deadline: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._eval_pool: Optional[ThreadPoolExecutor] = None
         self._inflight: list = []  # evaluation futures, FIFO
         self._last_batch = 0  # previous round's size (regime detector)
+        self._rounds_since_bulk = 0
 
     def start(self) -> None:
         if self._thread is None:
+            self._stopping = False
+            self._stop.clear()
             self._eval_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="acs-batch-eval"
             )
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_s: Optional[float] = None) -> None:
+        """Graceful drain: stop admitting, flush already-admitted batches
+        to completion (bounded by ``drain_s``, default from the admission
+        controller or 5 s), then fail anything still queued with the
+        shutdown status."""
+        if drain_s is None:
+            drain_s = (
+                self.admission.drain_deadline_s
+                if self.admission is not None else 5.0
+            )
+        self._stopping = True
+        if self.admission is not None:
+            self.admission.begin_drain()
+        self._drain_deadline = time.monotonic() + max(0.0, float(drain_s))
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=max(5.0, float(drain_s) + 5.0))
             self._thread = None
         if self._eval_pool is not None:
             self._eval_pool.shutdown(wait=True)
             self._eval_pool = None
         self._inflight = []
+        # anything the drain loop could not flush before the deadline:
+        # resolve with the shutdown status instead of leaving the caller's
+        # future hanging forever
+        self._fail_queued(self._queue, INTERACTIVE)
+        self._fail_queued(self._bulk, BULK)
 
-    def submit(self, request: Request) -> "Future[Response]":
+    def _fail_queued(self, q: "queue.Queue", cls: str) -> None:
+        n = 0
+        while True:
+            try:
+                _, future, _ = q.get_nowait()
+            except queue.Empty:
+                break
+            n += 1
+            if not future.done():
+                future.set_result(
+                    self._shutdown_result(cls)
+                )
+        if n and self.admission is not None:
+            self.admission.release(cls, n)
+            self.admission.shed_shutdown(n)
+
+    @staticmethod
+    def _shutdown_result(cls: str):
+        response = overload_response(
+            SHUTDOWN_CODE, "shut down before evaluation"
+        )
+        if cls == BULK:
+            return ReverseQuery(
+                policy_sets=[], obligations=[],
+                operation_status=response.operation_status,
+            )
+        return response
+
+    # ---------------------------------------------------------------- submit
+
+    def submit(
+        self, request: Request, deadline: Optional[float] = None
+    ) -> "Future[Response]":
         future: "Future[Response]" = Future()
         # decision-cache fast path: a warm cacheable request resolves
         # immediately instead of waiting out the collection window (and
-        # never occupies a batch slot).  The caller thread already ran
-        # prepare_context (srv/service.py), so the fingerprint is stable.
+        # never occupies a batch slot or an admission slot).  The caller
+        # thread already ran prepare_context (srv/service.py), so the
+        # fingerprint is stable.
         cache = getattr(self.evaluator, "decision_cache", None)
         if cache is not None and cache.enabled:
             engine = getattr(self.evaluator, "engine", None)
@@ -82,7 +170,36 @@ class MicroBatcher:
                     count("cache-hit", 1)
                 future.set_result(hit)
                 return future
-        self._queue.put((request, future))
+        if self._stopping:
+            future.set_result(self._shutdown_result(INTERACTIVE))
+            return future
+        if self.admission is not None:
+            shed = self.admission.admit(INTERACTIVE, deadline)
+            if shed is not None:
+                future.set_result(shed)
+                return future
+        self._queue.put((request, future, deadline))
+        return future
+
+    def submit_reverse(
+        self, request: Request, deadline: Optional[float] = None
+    ) -> "Future":
+        """Bulk-class submission: a whatIsAllowed reverse query resolved
+        with a ReverseQuery.  Only routed here under admission control
+        (srv/service.py keeps the direct caller-thread walk otherwise)."""
+        future: Future = Future()
+        if self._stopping:
+            future.set_result(self._shutdown_result(BULK))
+            return future
+        if self.admission is not None:
+            shed = self.admission.admit(BULK, deadline)
+            if shed is not None:
+                future.set_result(ReverseQuery(
+                    policy_sets=[], obligations=[],
+                    operation_status=shed.operation_status,
+                ))
+                return future
+        self._bulk.put((request, future, deadline))
         return future
 
     def is_allowed(self, request: Request, timeout: float = 30.0) -> Response:
@@ -90,13 +207,26 @@ class MicroBatcher:
 
     # ----------------------------------------------------------------- loop
 
+    def _effective_max_batch(self) -> int:
+        if self.admission is not None:
+            return self.admission.suggest_max_batch(self.max_batch)
+        return self.max_batch
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                first = self._queue.get(timeout=0.1)
+                # pending bulk work shortens the idle poll so a lone
+                # reverse query is not parked for the full 100 ms
+                poll = 0.005 if not self._bulk.empty() else 0.1
+                first = self._queue.get(timeout=poll)
             except queue.Empty:
+                # idle interactive round: bulk work proceeds immediately
+                # instead of waiting out the fairness interval
+                if not self._bulk.empty():
+                    self._serve_bulk()
                 continue
             batch = [first]
+            max_batch = self._effective_max_batch()
             # the collection window closes window_s after the FIRST item;
             # later arrivals only get the remaining slice, so a steady
             # trickle cannot stretch collection toward max_batch * window.
@@ -113,9 +243,9 @@ class MicroBatcher:
             busy = self._last_batch >= self.min_kernel_batch
             grace = self.window_s if busy else min(self.window_s, 0.0002)
             try:
-                if len(batch) < self.max_batch:
+                if len(batch) < max_batch:
                     batch.append(self._queue.get(timeout=grace))
-                while len(batch) < self.max_batch:
+                while len(batch) < max_batch:
                     remaining = close_at - time.monotonic()
                     if remaining <= 0:
                         break
@@ -123,33 +253,164 @@ class MicroBatcher:
             except queue.Empty:
                 pass
             self._last_batch = len(batch)
-            # host-side eligibility pipeline for THIS batch runs on the
-            # collector thread while the PREVIOUS batch is still evaluating
-            # on the eval worker — token resolution / HR rendezvous latency
-            # overlaps device execution (prepare_batch is idempotent; a
-            # failure here just leaves rows unprepared, and the encoder
-            # degrades them to the oracle)
-            prepare = getattr(self.evaluator, "prepare_batch", None)
-            if prepare is not None:
-                try:
-                    prepare([req for req, _ in batch])
-                except Exception:
-                    pass
-            # bounded pipeline: one batch evaluating + one queued at most
-            while len(self._inflight) >= 2:
-                self._inflight.pop(0).result()
-            self._inflight = [f for f in self._inflight if not f.done()]
-            self._inflight.append(
-                self._eval_pool.submit(self._eval_batch, batch)
+            self._dispatch_interactive(batch)
+            # two-class fairness: under interactive saturation, a bulk
+            # round still runs every ``bulk_interval`` interactive rounds
+            self._rounds_since_bulk += 1
+            interval = (
+                self.admission.bulk_interval
+                if self.admission is not None else 4
             )
+            if (
+                not self._bulk.empty()
+                and self._rounds_since_bulk >= interval
+            ):
+                self._serve_bulk()
+        # ------------------------------------------------------------ drain
+        # stop admitting happened in stop(); flush what was already
+        # admitted, bounded by the drain deadline, so accepted work is
+        # answered rather than abandoned
+        drain_until = self._drain_deadline or time.monotonic()
+        while time.monotonic() < drain_until:
+            batch = []
+            max_batch = self._effective_max_batch()
+            while len(batch) < max_batch:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            if batch:
+                self._dispatch_interactive(batch)
+            elif not self._bulk.empty():
+                self._serve_bulk()
+            else:
+                break
         for fut in self._inflight:
-            fut.result()
+            try:
+                fut.result(timeout=max(0.1, drain_until - time.monotonic()))
+            except Exception:  # noqa: BLE001 — drain best-effort
+                pass
         self._inflight = []
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch_interactive(self, batch: list) -> None:
+        if self.admission is not None:
+            self.admission.release(INTERACTIVE, len(batch))
+            batch = self._drop_expired(batch)
+            if not batch:
+                return
+        # host-side eligibility pipeline for THIS batch runs on the
+        # collector thread while the PREVIOUS batch is still evaluating
+        # on the eval worker — token resolution / HR rendezvous latency
+        # overlaps device execution (prepare_batch is idempotent; a
+        # failure here just leaves rows unprepared, and the encoder
+        # degrades them to the oracle)
+        prepare = getattr(self.evaluator, "prepare_batch", None)
+        if prepare is not None:
+            try:
+                prepare([req for req, _, _ in batch])
+            except Exception:
+                pass
+        # bounded pipeline: one batch evaluating + one queued at most
+        while len(self._inflight) >= 2:
+            self._inflight.pop(0).result()
+        self._inflight = [f for f in self._inflight if not f.done()]
+        self._inflight.append(
+            self._eval_pool.submit(self._eval_batch, batch)
+        )
+
+    def _drop_expired(self, batch: list, margin_s: float = 0.0) -> list:
+        """Rows whose deadline passed while queued resolve with the
+        deadline status NOW — evaluating them would burn a batch slot on
+        an answer the caller has already abandoned.  ``margin_s`` extends
+        the cut to rows that cannot SURVIVE the work ahead: the eval-time
+        pass uses the batch-latency estimate so a row with 1 ms of budget
+        never rides a 10 ms batch into a late answer."""
+        now = time.monotonic() + margin_s
+        live = []
+        expired = 0
+        for item in batch:
+            deadline = item[2]
+            if deadline is not None and deadline <= now:
+                expired += 1
+                if not item[1].done():
+                    item[1].set_result(overload_response(
+                        DEADLINE_CODE, "deadline expired before evaluation"
+                    ))
+            else:
+                live.append(item)
+        if expired and self.admission is not None:
+            self.admission.expired(expired)
+        return live
+
+    def _drop_expired_bulk(self, items: list) -> list:
+        """Bulk-class twin of ``_drop_expired``: expired reverse queries
+        resolve with a deadline-status ReverseQuery."""
+        now = time.monotonic()
+        live = []
+        expired = 0
+        for item in items:
+            deadline = item[2]
+            if deadline is not None and deadline <= now:
+                expired += 1
+                if not item[1].done():
+                    item[1].set_result(ReverseQuery(
+                        policy_sets=[], obligations=[],
+                        operation_status=overload_response(
+                            DEADLINE_CODE,
+                            "deadline expired before evaluation",
+                        ).operation_status,
+                    ))
+            else:
+                live.append(item)
+        if expired and self.admission is not None:
+            self.admission.expired(expired)
+        return live
+
+    def _serve_bulk(self) -> None:
+        """Drain one bulk round (bounded by max_batch) onto the eval
+        pipeline; reverse queries batch through the device-assisted
+        what_is_allowed_batch path."""
+        self._rounds_since_bulk = 0
+        items = []
+        while len(items) < self.max_batch:
+            try:
+                items.append(self._bulk.get_nowait())
+            except queue.Empty:
+                break
+        if not items:
+            return
+        if self.admission is not None:
+            self.admission.release(BULK, len(items))
+            items = self._drop_expired_bulk(items)
+        if not items:
+            return
+        while len(self._inflight) >= 2:
+            self._inflight.pop(0).result()
+        self._inflight = [f for f in self._inflight if not f.done()]
+        self._inflight.append(
+            self._eval_pool.submit(self._eval_bulk, items)
+        )
+
+    # ------------------------------------------------------------ evaluation
 
     def _eval_batch(self, batch: list) -> None:
         """Evaluate one collected batch and resolve its futures; runs on
         the single eval worker so batches complete in submission order."""
-        requests = [req for req, _ in batch]
+        t0 = time.perf_counter()
+        if self.admission is not None:
+            # second expiry pass: rows can outlive their deadline while
+            # waiting behind the in-flight batches of the depth-2 eval
+            # pipeline — drop them here, at the last instant before the
+            # evaluation actually starts, including rows whose remaining
+            # budget cannot cover this batch's estimated duration
+            batch = self._drop_expired(
+                batch, margin_s=self.admission.estimate_high(INTERACTIVE)
+            )
+            if not batch:
+                return
+        requests = [req for req, _, _ in batch]
         responses = None
         if len(batch) >= self.min_kernel_batch:
             try:
@@ -159,12 +420,43 @@ class MicroBatcher:
                 # retry each request individually below
                 responses = None
         if responses is not None:
-            for (_, future), response in zip(batch, responses):
+            for (_, future, _), response in zip(batch, responses):
                 future.set_result(response)
         else:
-            for req, future in batch:
+            for req, future, _ in batch:
                 try:
                     future.set_result(self.evaluator.is_allowed(req))
                 except Exception as err:
                     if not future.done():
                         future.set_exception(err)
+        if self.admission is not None:
+            self.admission.observe_batch(
+                INTERACTIVE, time.perf_counter() - t0, len(batch)
+            )
+
+    def _eval_bulk(self, items: list) -> None:
+        """Evaluate one bulk (reverse-query) round on the eval worker."""
+        t0 = time.perf_counter()
+        if self.admission is not None:
+            items = self._drop_expired_bulk(items)
+            if not items:
+                return
+        requests = [req for req, _, _ in items]
+        try:
+            results = self.evaluator.what_is_allowed_batch(requests)
+        except Exception:
+            results = None
+        if results is not None:
+            for (_, future, _), rq in zip(items, results):
+                future.set_result(rq)
+        else:
+            for req, future, _ in items:
+                try:
+                    future.set_result(self.evaluator.what_is_allowed(req))
+                except Exception as err:
+                    if not future.done():
+                        future.set_exception(err)
+        if self.admission is not None:
+            self.admission.observe_batch(
+                BULK, time.perf_counter() - t0, len(items)
+            )

@@ -54,9 +54,14 @@ class BrokerServer:
     consumer offsets and the KV store survive broker restarts — the role
     Kafka's commit log and Redis persistence play for the reference
     (src/worker.ts:123,354-361: offsets resumed per topic at subscribe).
-    The journal is append-only; it is flushed per record but not fsynced
-    (a broker-process crash loses nothing already flushed; only a
-    host-level crash can drop the tail).
+    The journal is append-only; it is flushed per record but, by default,
+    not fsynced (a broker-process crash loses nothing already flushed;
+    only a host-level crash can drop the tail).  ``fsync_interval_s``
+    closes that host-crash tail-loss window: when set, the journal is
+    additionally fsynced whenever at least that many seconds have passed
+    since the last fsync (0 fsyncs every record — Kafka's
+    flush.messages=1 posture, at the corresponding write-latency cost).
+    None (the default) preserves the flush-only semantics exactly.
 
     ``secret`` enables authentication: the first frame of every
     connection must be {"op": "auth", "secret": ...} or the connection is
@@ -70,7 +75,8 @@ class BrokerServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  data_dir: Optional[str] = None,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 fsync_interval_s: Optional[float] = None):
         if host not in ("127.0.0.1", "localhost", "::1"):
             import sys as _sys
 
@@ -87,6 +93,10 @@ class BrokerServer:
         self._lock = threading.Lock()
         self.secret = secret
         self._journal = None
+        self.fsync_interval_s = (
+            None if fsync_interval_s is None else float(fsync_interval_s)
+        )
+        self._last_fsync = 0.0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             path = os.path.join(data_dir, "broker.journal")
@@ -180,6 +190,13 @@ class BrokerServer:
         if self._journal is not None:
             self._journal.write(json.dumps(rec) + "\n")
             self._journal.flush()
+            if self.fsync_interval_s is not None:
+                import time as _time
+
+                now = _time.monotonic()
+                if now - self._last_fsync >= self.fsync_interval_s:
+                    os.fsync(self._journal.fileno())
+                    self._last_fsync = now
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, cmd: dict) -> dict:

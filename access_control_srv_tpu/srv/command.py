@@ -17,12 +17,13 @@ from .. import __version__
 
 class CommandInterface:
     def __init__(self, cfg, service, store=None, bus=None, cache=None,
-                 decision_cache=None, logger=None):
+                 decision_cache=None, admission=None, logger=None):
         self.cfg = cfg
         self.service = service
         self.store = store
         self.cache = cache
         self.decision_cache = decision_cache
+        self.admission = admission
         self.logger = logger
         self.api_key: Optional[str] = None
         self.start_time = time.time()
@@ -122,6 +123,11 @@ class CommandInterface:
                 # pipeline's per-batch RPC amortizer (srv/identity.py)
                 detail["token_resolution_cache"] = \
                     identity_client.cache_stats()
+            if self.admission is not None:
+                # overload posture: admitted/shed/deadline counters, live
+                # queue depths vs bounds, breaker states, latency
+                # estimates (srv/admission.py)
+                detail["admission"] = self.admission.stats()
         except Exception as err:  # pragma: no cover
             healthy = False
             detail["error"] = str(err)

@@ -52,6 +52,44 @@ DEFAULT_CONFIG: dict = {
         "shards": 16,
     },
     "adapter": {},
+    # deadline-aware admission control + overload protection
+    # (srv/admission.py, docs/ADMISSION.md).  Disabled by default: the
+    # serving path is then byte-identical to pre-admission behavior.
+    # Enabled, every request passes a bounded two-class queue (interactive
+    # isAllowed vs bulk whatIsAllowed) with deadline-feasibility checks
+    # against the batch-latency EWMA; sheds answer INDETERMINATE with the
+    # overload operation_status (429 shed / 504 deadline / 503 shutdown),
+    # never a fabricated PERMIT/DENY.
+    "admission": {
+        "enabled": False,
+        "max_queue_interactive": 8192,
+        "max_queue_bulk": 1024,
+        # admit only when remaining budget > estimate * headroom
+        "deadline_headroom": 1.2,
+        "ewma_alpha": 0.2,
+        "ewma_default_ms": 5.0,
+        # adaptive max-batch: shrink the collection cap when batch
+        # latency overshoots deadline_bound_ms, regrow when comfortable
+        "adaptive_max_batch": True,
+        "deadline_bound_ms": 50.0,
+        "min_batch": 64,
+        # graceful shutdown: how long Worker.stop flushes already-admitted
+        # batches before failing the rest with the shutdown status
+        "drain_deadline_s": 5.0,
+        # two-class fairness: a bulk round runs at least every N
+        # interactive rounds under saturation
+        "bulk_interval": 4,
+        # dependency circuit breakers (adapter context queries + identity
+        # token resolution): closed/open/half-open with jittered probe
+        "breakers": {
+            "enabled": True,
+            "window_s": 10.0,
+            "min_volume": 8,
+            "failure_ratio": 0.5,
+            "open_s": 2.0,
+            "half_open_probes": 2,
+        },
+    },
     "logger": {"maskFields": ["password", "token"]},
 }
 

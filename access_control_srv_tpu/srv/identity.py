@@ -171,10 +171,39 @@ class TokenResolutionCache:
         return out
 
 
+def _breaker_envelope() -> dict:
+    """The fast-fail resolution when the identity breaker is open: a 5xx
+    envelope — never cached (TokenResolutionCache refuses >=500), so
+    recovery is immediate, and the row degrades per-row to
+    ``token-unresolved`` exactly like a timed-out RPC would."""
+    return {
+        "payload": None,
+        "status": {"code": 503, "message": "identity circuit open"},
+    }
+
+
+def _record_envelope(breaker, envelope) -> None:
+    """Feed a resolution outcome to the breaker: transport-level failures
+    (5xx envelopes, the shape RPC exceptions fold into) count against the
+    failure window; definitive answers — hits AND 404s — are successes
+    (the upstream answered)."""
+    if breaker is None:
+        return
+    status = (envelope or {}).get("status") or {}
+    code = status.get("code")
+    if isinstance(code, int) and code >= 500:
+        breaker.record_failure()
+    else:
+        breaker.record_success()
+
+
 class CachingIdentityClient:
     """TTL'd resolution cache around ANY identity client (the static map in
     tests/benches, custom transports in deployments).  GrpcIdentityClient
-    carries the same cache built in — do not stack both."""
+    carries the same cache built in — do not stack both.  ``breaker``
+    (srv/admission.CircuitBreaker) guards the inner client: an open
+    circuit resolves to the 503 envelope immediately — cache hits are
+    served regardless (they need no upstream)."""
 
     def __init__(
         self,
@@ -183,8 +212,10 @@ class CachingIdentityClient:
         negative_ttl_s: float = 30.0,
         max_entries: int = 4096,
         counter=None,
+        breaker=None,
     ):
         self.inner = inner
+        self.breaker = breaker
         self.cache = TokenResolutionCache(
             ttl_s=ttl_s, negative_ttl_s=negative_ttl_s,
             max_entries=max_entries, counter=counter,
@@ -194,8 +225,16 @@ class CachingIdentityClient:
         hit, gen = self.cache.lookup(token)
         if hit is not None:
             return hit
-        out = self.inner.find_by_token(token)
+        if self.breaker is not None and not self.breaker.allow():
+            return _breaker_envelope()
+        try:
+            out = self.inner.find_by_token(token)
+        except Exception:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
         if isinstance(out, dict):
+            _record_envelope(self.breaker, out)
             self.cache.store(token, out, gen)
         return out
 
@@ -248,7 +287,7 @@ class GrpcIdentityClient:
     def __init__(self, address: str, timeout: float = 5.0,
                  cache_size: int = 1024, logger=None,
                  ttl_s: float = 600.0, negative_ttl_s: float = 30.0,
-                 counter=None):
+                 counter=None, breaker=None):
         import grpc
 
         from .gen import access_control_pb2 as pb
@@ -272,6 +311,10 @@ class GrpcIdentityClient:
             ttl_s=ttl_s, negative_ttl_s=negative_ttl_s,
             max_entries=cache_size, counter=counter,
         )
+        # shared circuit breaker (srv/admission.CircuitBreaker): a down
+        # identity service fails resolutions fast (rows degrade per-row
+        # to token-unresolved) instead of paying `timeout` per request
+        self.breaker = breaker
 
     def find_by_token(self, token: str) -> Optional[dict]:
         import json
@@ -279,6 +322,8 @@ class GrpcIdentityClient:
         hit, gen = self._cache.lookup(token)
         if hit is not None:
             return hit
+        if self.breaker is not None and not self.breaker.allow():
+            return _breaker_envelope()
         try:
             resp = self._call(
                 self._pb.FindByTokenRequest(token=token),
@@ -289,6 +334,8 @@ class GrpcIdentityClient:
                 self.logger.warning(
                     "identity findByToken failed: %s", err
                 )
+            if self.breaker is not None:
+                self.breaker.record_failure()
             # 5xx: never cached, so recovery after an outage is immediate
             return {"payload": None,
                     "status": {"code": 503, "message": str(err)}}
@@ -303,6 +350,7 @@ class GrpcIdentityClient:
             "status": {"code": resp.status.code or 200,
                        "message": resp.status.message},
         }
+        _record_envelope(self.breaker, out)
         self._cache.store(token, out, gen)
         return out
 

@@ -88,18 +88,32 @@ class AccessControlService:
 
     # ------------------------------------------------------------- endpoints
 
-    def is_allowed(self, request: Any) -> Response:
+    def is_allowed(self, request: Any,
+                   deadline: Optional[float] = None) -> Response:
         """Deny-by-default on any evaluation exception
-        (reference: accessControlService.ts:62-81)."""
+        (reference: accessControlService.ts:62-81).  ``deadline`` is an
+        absolute monotonic instant propagated from the transport (gRPC
+        deadline / x-acs-timeout-ms metadata, srv/admission.py): it rides
+        the request as ``_deadline`` for deadline-aware adapter retries
+        and, with admission enabled, gates the batcher submit."""
         t0 = time.perf_counter()
         try:
             req = coerce_request(request)
+            if deadline is not None:
+                req._deadline = deadline
             if self.batcher is not None:
                 # resolve token subject + HR scopes in THIS thread: the
                 # rendezvous can block for up to hrReqTimeout, which must
                 # never happen on the batcher's collector thread
                 self.engine.prepare_context(req)
-                response = self.batcher.is_allowed(req)
+                timeout = 30.0
+                if deadline is not None:
+                    timeout = min(
+                        timeout, max(0.1, deadline - time.monotonic()) + 5.0
+                    )
+                response = self.batcher.submit(
+                    req, deadline=deadline
+                ).result(timeout=timeout)
             elif self.evaluator is not None:
                 response = self.evaluator.is_allowed(req)
             else:
@@ -122,7 +136,8 @@ class AccessControlService:
             )
 
     def is_allowed_batch(
-        self, requests: list, observe: bool = True
+        self, requests: list, observe: bool = True,
+        deadline: Optional[float] = None,
     ) -> list[Response]:
         # observe=False lets a caller that does its own per-RPC telemetry
         # (the raw-bytes gRPC fast path serving fallback rows through here)
@@ -143,6 +158,9 @@ class AccessControlService:
                 Response(decision=Decision.DENY, operation_status=status)
                 for _ in requests
             ]
+        if deadline is not None:
+            for req in reqs:
+                req._deadline = deadline
         try:
             if self.evaluator is not None:
                 responses = self.evaluator.is_allowed_batch(reqs)
@@ -166,13 +184,43 @@ class AccessControlService:
                 for _ in reqs
             ]
 
-    def what_is_allowed_batch(self, requests: list) -> list[ReverseQuery]:
+    def _admission(self):
+        """The admission controller when one is wired AND enabled (via
+        the batcher — srv/worker.py), else None."""
+        batcher = self.batcher
+        admission = getattr(batcher, "admission", None)
+        if admission is not None and admission.enabled:
+            return admission
+        return None
+
+    def what_is_allowed_batch(
+        self, requests: list, deadline: Optional[float] = None
+    ) -> list[ReverseQuery]:
         """Batched reverse query through the device-assisted path
         (framework extension; single-request semantics per row with the
-        same deny-on-exception error shape)."""
+        same deny-on-exception error shape).  Under admission control the
+        whole batch is one BULK-class admission unit: saturation sheds it
+        with the overload status instead of queueing unboundedly."""
         t0 = time.perf_counter()
+        admission = self._admission()
+        released = True
         try:
             reqs = [coerce_request(r) for r in requests]
+            if deadline is not None:
+                for req in reqs:
+                    req._deadline = deadline
+            if admission is not None:
+                from .admission import BULK
+
+                shed = admission.admit(BULK, deadline)
+                if shed is not None:
+                    self._observe("what_is_allowed_latency", t0)
+                    return [
+                        ReverseQuery(policy_sets=[], obligations=[],
+                                     operation_status=shed.operation_status)
+                        for _ in reqs
+                    ]
+                released = False
             if self.evaluator is not None:
                 out = self.evaluator.what_is_allowed_batch(reqs)
             else:
@@ -193,13 +241,38 @@ class AccessControlService:
                              operation_status=status)
                 for _ in requests
             ]
+        finally:
+            if admission is not None and not released:
+                from .admission import BULK
 
-    def what_is_allowed(self, request: Any) -> ReverseQuery:
-        """(reference: accessControlService.ts:83-101)"""
+                admission.release(BULK, 1)
+
+    def what_is_allowed(self, request: Any,
+                        deadline: Optional[float] = None) -> ReverseQuery:
+        """(reference: accessControlService.ts:83-101)
+
+        With admission enabled, reverse queries are the BULK traffic
+        class: they ride the batcher's bounded bulk queue (shed with the
+        overload status when saturated) so interactive isAllowed traffic
+        keeps its latency bound under a reverse-query flood — and vice
+        versa, the fairness interval keeps bulk progressing."""
         t0 = time.perf_counter()
         try:
             req = coerce_request(request)
-            rq = self.engine.what_is_allowed(req)
+            if deadline is not None:
+                req._deadline = deadline
+            if self._admission() is not None:
+                self.engine.prepare_context(req)
+                timeout = 30.0
+                if deadline is not None:
+                    timeout = min(
+                        timeout, max(0.1, deadline - time.monotonic()) + 5.0
+                    )
+                rq = self.batcher.submit_reverse(
+                    req, deadline=deadline
+                ).result(timeout=timeout)
+            else:
+                rq = self.engine.what_is_allowed(req)
             self._observe("what_is_allowed_latency", t0)
             return rq
         except Exception as err:

@@ -170,6 +170,50 @@ class Histogram:
         return out
 
 
+class ValueHistogram:
+    """Fixed pow2-bucket histogram for dimensionless values (queue
+    depths); thread-safe, O(1) observe like ``Histogram``."""
+
+    BOUNDS = [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384,
+              65536, float("inf")]
+
+    def __init__(self):
+        self._counts = [0] * len(self.BOUNDS)
+        self._sum = 0.0
+        self._n = 0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = 0
+        for idx, bound in enumerate(self.BOUNDS):
+            if value <= bound:
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._n += 1
+            if value > self._max:
+                self._max = value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            total, n, peak = self._sum, self._n, self._max
+        out = {
+            "count": n,
+            "mean": round(total / n, 3) if n else None,
+            "max": peak,
+            "buckets": {},
+        }
+        cumulative = 0
+        for bound, count in zip(self.BOUNDS, counts):
+            cumulative += count
+            label = "inf" if math.isinf(bound) else f"{bound:g}"
+            out["buckets"][label] = cumulative
+        return out
+
+
 class Counter:
     def __init__(self):
         self._values: dict[str, int] = {}
@@ -207,6 +251,13 @@ class Telemetry:
         # visibility latency (CRUD call to kernel swap) per update
         self.delta = Counter()
         self.policy_update_latency = Histogram()
+        # admission control (srv/admission.py): admitted / shed /
+        # deadline-rejected / breaker-transition counters, the queue-depth
+        # distribution at admit and the remaining-deadline-budget
+        # distribution (seconds) of deadline-bearing requests
+        self.admission = Counter()
+        self.admission_queue_depth = ValueHistogram()
+        self.admission_budget = Histogram()
         self.start_time = time.time()
 
     @contextmanager
@@ -236,6 +287,11 @@ class Telemetry:
             "policy_update": {
                 **self.delta.snapshot(),
                 "latency": self.policy_update_latency.snapshot(),
+            },
+            "admission": {
+                **self.admission.snapshot(),
+                "queue_depth": self.admission_queue_depth.snapshot(),
+                "budget_s": self.admission_budget.snapshot(),
             },
         }
 

@@ -27,6 +27,7 @@ from ..models.model import (
     Target,
 )
 from ..ops.compile import DECISION_NAMES
+from .admission import deadline_from_context
 from .gen import access_control_pb2 as pb
 
 
@@ -396,7 +397,14 @@ class GrpcServer:
         worker = self.worker
 
         def is_allowed(request, context):
-            response = worker.service.is_allowed(request_from_pb(request))
+            # deadline propagation (srv/admission.py): the client's gRPC
+            # deadline (or x-acs-timeout-ms metadata) becomes the
+            # request's budget — rejected at submit when infeasible,
+            # dropped at dispatch when expired
+            response = worker.service.is_allowed(
+                request_from_pb(request),
+                deadline=deadline_from_context(context),
+            )
             return response_to_pb(response)
 
         def is_allowed_batch(raw, context):
@@ -406,6 +414,7 @@ class GrpcServer:
             import time as _time
 
             t0 = _time.perf_counter()
+            deadline = deadline_from_context(context)
             messages = split_batch_request(raw)
             evaluator = worker.service.evaluator
             if messages is not None and evaluator is not None:
@@ -457,7 +466,8 @@ class GrpcServer:
                         for b, resp in zip(
                             fallback_rows,
                             worker.service.is_allowed_batch(
-                                fallback_reqs, observe=False
+                                fallback_reqs, observe=False,
+                                deadline=deadline,
                             ),
                         ):
                             responses[b] = response_to_pb(resp)
@@ -473,19 +483,24 @@ class GrpcServer:
                     return serialize_batch_response(responses)
             request = pb.BatchRequest.FromString(raw)
             responses = worker.service.is_allowed_batch(
-                [request_from_pb(r) for r in request.requests]
+                [request_from_pb(r) for r in request.requests],
+                deadline=deadline,
             )
             return serialize_batch_response(
                 [response_to_pb(r) for r in responses]
             )
 
         def what_is_allowed(request, context):
-            rq = worker.service.what_is_allowed(request_from_pb(request))
+            rq = worker.service.what_is_allowed(
+                request_from_pb(request),
+                deadline=deadline_from_context(context),
+            )
             return reverse_query_to_pb(rq)
 
         def what_is_allowed_batch(request, context):
             rqs = worker.service.what_is_allowed_batch(
-                [request_from_pb(m) for m in request.requests]
+                [request_from_pb(m) for m in request.requests],
+                deadline=deadline_from_context(context),
             )
             return pb.BatchReverseQuery(
                 responses=[reverse_query_to_pb(rq) for rq in rqs]

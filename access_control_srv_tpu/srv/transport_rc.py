@@ -36,6 +36,7 @@ import json
 import grpc
 
 from ..models.model import Attribute, Request, Target
+from .admission import deadline_from_context
 from .gen.rc import access_control_pb2 as rc_ac
 from .gen.rc import attribute_pb2 as rc_attr
 from .gen.rc import commandinterface_pb2 as rc_ci
@@ -398,13 +399,22 @@ def register_rc_services(server, worker) -> None:
     (called by GrpcServer alongside the acstpu services)."""
 
     def is_allowed(request, context):
+        # rc-wire deadline propagation: native gRPC deadlines and the
+        # x-acs-timeout-ms metadata key both become the request budget
+        # (srv/admission.deadline_from_context)
         return response_to_rc(
-            worker.service.is_allowed(request_from_rc(request))
+            worker.service.is_allowed(
+                request_from_rc(request),
+                deadline=deadline_from_context(context),
+            )
         )
 
     def what_is_allowed(request, context):
         return reverse_query_to_rc(
-            worker.service.what_is_allowed(request_from_rc(request))
+            worker.service.what_is_allowed(
+                request_from_rc(request),
+                deadline=deadline_from_context(context),
+            )
         )
 
     server.add_generic_rpc_handlers((

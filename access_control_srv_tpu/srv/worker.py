@@ -12,6 +12,7 @@ from ..core.engine import AccessController
 from ..core.loader import load_policy_sets_from_file
 from ..models.model import Decision
 from ..models.urns import Urns
+from .admission import AdmissionController
 from .batcher import MicroBatcher
 from .cache import HRScopeProvider, SubjectCache, compare_role_associations
 from .command import CommandInterface
@@ -52,6 +53,7 @@ class Worker:
         self.bus: Optional[EventBus] = None
         self.subject_cache: Optional[SubjectCache] = None
         self.decision_cache = None
+        self.admission: Optional[AdmissionController] = None
         self.hr_provider: Optional[HRScopeProvider] = None
         self.identity_client = None
         self.offset_store: Optional[OffsetStore] = None
@@ -138,6 +140,15 @@ class Worker:
             cfg, telemetry=self.telemetry
         )
 
+        # admission control (srv/admission.py): deadline-aware bounded
+        # queues + shedding at the batcher, dependency circuit breakers
+        # on the adapter/identity clients, graceful drain on stop.
+        # Disabled (the default) the controller admits unconditionally
+        # and the serving path is byte-identical to pre-admission code.
+        self.admission = AdmissionController.from_config(
+            cfg, telemetry=self.telemetry
+        )
+
         # identity client: a live gRPC channel when the config names an
         # identity-service address (reference: src/worker.ts:135-143),
         # otherwise the in-memory static map
@@ -164,6 +175,7 @@ class Worker:
                         "client:identity:cache:negative_ttl_s", 30.0
                     )),
                     counter=self.telemetry.identity,
+                    breaker=self.admission.breaker("identity"),
                 )
             else:
                 self.identity_client = StaticIdentityClient()
@@ -180,7 +192,9 @@ class Worker:
         )
         adapter_cfg = cfg.get("adapter") or {}
         if adapter_cfg.get("graphql"):
-            self.engine.create_resource_adapter(adapter_cfg)
+            self.engine.create_resource_adapter(
+                adapter_cfg, breaker=self.admission.breaker("adapter")
+            )
         # multi-chip serving: `parallel:data_devices` (int, or "all")
         # builds a data-parallel mesh the evaluator shards request batches
         # over; `parallel:model_devices` (int > 1) additionally shards the
@@ -296,12 +310,14 @@ class Worker:
             bus=self.bus,
             cache=self.subject_cache,
             decision_cache=self.decision_cache,
+            admission=self.admission,
             logger=self.logger,
         )
         self.batcher = MicroBatcher(
             self.evaluator,
             window_ms=cfg.get("evaluator:micro_batch_window_ms", 2),
             max_batch=cfg.get("evaluator:micro_batch_max", 4096),
+            admission=self.admission,
         )
         self.batcher.start()
         self.service.batcher = self.batcher
@@ -349,6 +365,9 @@ class Worker:
 
     def stop(self) -> None:
         if self.batcher is not None:
+            # graceful drain: stop admitting, flush already-admitted
+            # batches bounded by the drain deadline, fail the rest with
+            # the shutdown status (srv/batcher.MicroBatcher.stop)
             self.batcher.stop()
         if self.evaluator is not None:
             # join the debounced async-compile worker instead of leaking a

@@ -66,7 +66,10 @@ _CONFIG_KNOBS = (
     "WIA_N", "WIA_RULES", "WIA_LARGE_N", "HRDEEP_N", "MIXED_RULES",
     "MIXED_CHUNK", "MIXED_TOTAL", "SERVE_RULES", "SERVE_BATCH",
     "SERVE_CALLS", "TOKENMIX_RULES", "TOKENMIX_CHUNK", "TOKENMIX_TOTAL",
-    "TOKENMIX_TOKENS", "BENCH_PLATFORM",
+    "TOKENMIX_TOKENS", "BENCH_PLATFORM", "OVERLOAD_DEADLINE_MS",
+    "OVERLOAD_DURATION_S", "OVERLOAD_X", "OVERLOAD_QUEUE",
+    "OVERLOAD_GENERATORS", "OVERLOAD_WARMUP_S", "OVERLOAD_CAL_THREADS",
+    "OVERLOAD_RULES",
 )
 
 
@@ -822,21 +825,26 @@ def bench_stress_hr():
 # ------------------------------------------- configs 8-10: serving wire-to-wire
 
 
-def _serving_worker(n_rules=0):
+def _serving_worker(n_rules=0, cfg_extra=None, serve_grpc=True):
     """Worker + gRPC server + client over loopback; seed tree, plus an
-    optional synthetic stress corpus upserted into the store."""
+    optional synthetic stress corpus upserted into the store.
+    ``cfg_extra`` overlays top-level config blocks (admission / evaluator
+    / decision_cache overrides); ``serve_grpc=False`` returns
+    (worker, None, None) for benches that drive the batcher directly."""
     from access_control_srv_tpu.srv import Worker
     from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
 
     seed = os.path.join(REPO, "data", "seed_data")
-    worker = Worker().start({
+    cfg = {
         "policies": {"type": "database"},
         "seed_data": {
             "policy_sets": os.path.join(seed, "policy_sets.yaml"),
             "policies": os.path.join(seed, "policies.yaml"),
             "rules": os.path.join(seed, "rules.yaml"),
         },
-    })
+    }
+    cfg.update(cfg_extra or {})
+    worker = Worker().start(cfg)
     if n_rules:
         engine, _ = _stress_engine(n_rules)
         docs = {"rule": [], "policy": [], "policy_set": []}
@@ -866,6 +874,8 @@ def _serving_worker(n_rules=0):
             docs["policy_set"].append(ps_doc)
         worker.store.seed(docs["policy_set"], docs["policy"], docs["rule"])
         worker.evaluator.refresh(wait=True)
+    if not serve_grpc:
+        return worker, None, None
     server = GrpcServer(worker, "127.0.0.1:0").start()
     client = GrpcClient(server.addr)
     return worker, server, client
@@ -1449,7 +1459,211 @@ def bench_crud_churn():
     )
 
 
-HOST_ONLY = {"scalar", "wia"}
+def bench_overload():
+    """Admission-controlled serving at >=4x sustainable offered load
+    (srv/admission.py, docs/ADMISSION.md): open-loop generators fire
+    deadline-bearing requests at the micro-batcher; the bar is CONTROLLED
+    degradation — admitted-request p99 within the deadline bound, sheds
+    answering the overload operation_status (never a fabricated
+    PERMIT/DENY), queue depth bounded by config.  Host-only by
+    construction (admission owns zero device state)."""
+    import threading as _threading
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.srv import Worker
+
+    # default bound sized for the CPU fallback: the pure-python load
+    # generators contend with the eval worker for the GIL, inflating
+    # batch jitter far beyond what a deployed worker (gRPC I/O threads +
+    # device kernel) sees; on-chip, 50 ms is comfortable
+    deadline_ms = float(os.environ.get("OVERLOAD_DEADLINE_MS", 100.0))
+    duration_s = float(os.environ.get("OVERLOAD_DURATION_S", 3.0))
+    offered_x = float(os.environ.get("OVERLOAD_X", 4.0))
+    queue_bound = int(os.environ.get("OVERLOAD_QUEUE", 256))
+    generators = int(os.environ.get("OVERLOAD_GENERATORS", 4))
+    # a tree large enough that the DECISION dominates the submit-path
+    # python overhead — otherwise the load generators, not the evaluator,
+    # are what saturates, and the bench measures the harness
+    n_rules = int(os.environ.get("OVERLOAD_RULES", 10_000))
+
+    worker, _, _ = _serving_worker(n_rules, serve_grpc=False, cfg_extra={
+        # the cache would absorb the repeat traffic and measure nothing
+        "decision_cache": {"enabled": False},
+        # oracle backend: admission is host-side by construction (audit
+        # row admission-zero-device-ops); the oracle isolates overload
+        # behavior from per-batch-shape XLA compile warmup, which on the
+        # CPU fallback dwarfs every latency this bench is about.  Kernel
+        # throughput has its own rows (serve / stress).
+        "evaluator": {"backend": "oracle"},
+        "admission": {
+            "enabled": True,
+            "max_queue_interactive": queue_bound,
+            "deadline_bound_ms": deadline_ms,
+            # ~1.4 ms/row oracle walks: the default 64-row floor alone
+            # would exceed the deadline bound per batch
+            "min_batch": 8,
+        },
+    })
+    urns = Urns()
+
+    def make_request(i):
+        role = f"role-{i % 108}"
+        k = i % 64
+        entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"], value=role),
+                          Attribute(id=urns["subjectID"], value=f"u{i}")],
+                resources=[Attribute(id=urns["entity"], value=entity),
+                           Attribute(id=urns["resourceID"], value=f"r{i}")],
+                actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+            ),
+            context={"resources": [], "subject": {
+                "id": f"u{i}",
+                "role_associations": [{"role": role, "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        )
+
+    corpus = [make_request(i) for i in range(512)]
+    batcher = worker.batcher
+    try:
+        # --------------------------------------- sustainable calibration
+        # closed loop: each thread keeps exactly one request outstanding,
+        # so completion rate == what the serving path sustains.  The first
+        # pass is a DISCARDED warmup — it absorbs the XLA compiles of the
+        # first few batch shapes, which would otherwise poison both the
+        # sustainable estimate and the admission EWMA
+        warmup_s = float(os.environ.get("OVERLOAD_WARMUP_S", 1.0))
+        # enough outstanding requests to keep the eval pipeline saturated
+        # (kernel-sized batches), so the closed loop measures CAPACITY and
+        # "4x sustainable" is a genuine overload
+        cal_threads = int(os.environ.get("OVERLOAD_CAL_THREADS", 64))
+
+        def closed_loop_for(seconds):
+            stop_cal = _threading.Event()
+            completed = [0] * cal_threads
+
+            def closed_loop(slot):
+                i = slot
+                while not stop_cal.is_set():
+                    batcher.submit(
+                        corpus[i % len(corpus)]
+                    ).result(timeout=60)
+                    completed[slot] += 1
+                    i += cal_threads
+
+            threads = [_threading.Thread(target=closed_loop, args=(s,))
+                       for s in range(cal_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(seconds)
+            stop_cal.set()
+            for t in threads:
+                t.join()
+            return sum(completed) / (time.perf_counter() - t0)
+
+        closed_loop_for(warmup_s)  # discarded: warmup
+        sustainable = closed_loop_for(1.0)
+
+        # ------------------------------------------------ overload phase
+        # open loop at offered_x * sustainable: generators fire paced
+        # submits WITHOUT waiting for results — exactly the arrival
+        # process that turns an unbounded queue into a timeout storm
+        offered = offered_x * sustainable
+        per_gen_interval = generators / offered
+        outcomes: list[tuple[float, float, int]] = []  # (t0, t_done, code)
+        outcomes_lock = _threading.Lock()
+
+        def open_loop(slot):
+            n_shots = int(duration_s / per_gen_interval)
+            next_at = time.monotonic() + slot * (per_gen_interval / generators)
+            for i in range(n_shots):
+                delay = next_at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                next_at += per_gen_interval
+                t_sub = time.monotonic()
+                fut = batcher.submit(
+                    corpus[(slot + i * generators) % len(corpus)],
+                    deadline=t_sub + deadline_ms / 1e3,
+                )
+
+                def on_done(f, t_sub=t_sub):
+                    try:
+                        code = f.result().operation_status.code
+                    except Exception:
+                        code = -1
+                    with outcomes_lock:
+                        outcomes.append((t_sub, time.monotonic(), code))
+
+                fut.add_done_callback(on_done)
+
+        threads = [_threading.Thread(target=open_loop, args=(s,))
+                   for s in range(generators)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # let in-flight batches land (bounded — the queue is bounded)
+        deadline_wait = time.monotonic() + 10.0
+        total_fired = int(duration_s / per_gen_interval) * generators
+        while time.monotonic() < deadline_wait:
+            with outcomes_lock:
+                if len(outcomes) >= total_fired:
+                    break
+            time.sleep(0.05)
+        elapsed = time.perf_counter() - t0
+
+        with outcomes_lock:
+            snap = list(outcomes)
+        admitted = sorted(
+            (done - sub) * 1e3 for sub, done, code in snap if code == 200
+        )
+        shed = [code for _, _, code in snap if code in (429, 503, 504)]
+        stats = worker.admission.stats()
+        n = max(1, len(snap))
+        p50 = admitted[len(admitted) // 2] if admitted else None
+        p99 = admitted[int(len(admitted) * 0.99)] if admitted else None
+        return _result(
+            f"isAllowed admitted decisions/sec under {offered_x:g}x "
+            f"overload (admission control, {n_rules}-rule tree)",
+            len(admitted) / elapsed,
+            "decisions/s",
+            {
+                "sustainable_rps": round(sustainable, 1),
+                "offered_rps": round(offered, 1),
+                "offered_x": offered_x,
+                "fired": len(snap),
+                "shed_fraction": round(len(shed) / n, 4),
+                "admitted_p50_ms": round(p50, 3) if p50 else None,
+                "admitted_p99_ms": round(p99, 3) if p99 else None,
+                "deadline_ms": deadline_ms,
+                "p99_within_deadline": bool(p99 is not None
+                                            and p99 <= deadline_ms),
+                "queue_bound": queue_bound,
+                "max_queue_depth_seen":
+                    stats["max_queue_depth_seen"]["interactive"],
+                "queue_bounded": bool(
+                    stats["max_queue_depth_seen"]["interactive"]
+                    <= queue_bound
+                ),
+                "admitted": stats["admitted"],
+                "shed_queue_full": stats["shed_queue_full"],
+                "deadline_rejected": stats["deadline_rejected"],
+                "deadline_expired": stats["deadline_expired"],
+                "bar": "admitted p99 <= deadline bound; sheds are "
+                       "INDETERMINATE + overload status (429/504), never "
+                       "a fabricated PERMIT/DENY; queue depth bounded",
+            },
+        )
+    finally:
+        worker.stop()
+
+
+HOST_ONLY = {"scalar", "wia", "overload"}
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
 
@@ -1457,7 +1671,7 @@ def main():
     which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
                              "hr-deep", "stress", "stress-hr", "serve",
                              "serve-latency", "token-mix", "adapter-mixed",
-                             "adapter-mixed-warm", "crud-churn"]
+                             "adapter-mixed-warm", "crud-churn", "overload"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -1538,6 +1752,7 @@ def main():
         "adapter-mixed": bench_adapter_mixed,
         "adapter-mixed-warm": bench_adapter_mixed_warm,
         "crud-churn": bench_crud_churn,
+        "overload": bench_overload,
     }
     for name in which:
         row = fns[name]()

@@ -495,9 +495,10 @@ def main() -> int:
     }
     d_stats = hybrid_d.delta_stats()
 
-    def _lower_dyn(compiled_tbl):
+    def _lower_dyn(compiled_tbl, reqs=None):
         kern = DecisionKernel(compiled_tbl, dynamic_policies=True)
-        batch = encode_requests(d_reqs, compiled_tbl)
+        batch = encode_requests(reqs if reqs is not None else d_reqs,
+                                compiled_tbl)
         _, bk, ebk, padl = _lead_padding(batch)
         largs = (
             kern._c,
@@ -552,6 +553,48 @@ def main() -> int:
                  "(zero new XLA compilations) and the patched tables lower "
                  "to the byte-identical program as a bucketed full "
                  "recompile of the final tree"),
+    })
+
+    # 6. admission control must be host-only: the module may not import
+    # jax, and a batch whose requests passed through an ENABLED admission
+    # controller (deadline attached, admit/release cycle, EWMA observed)
+    # must lower to the BYTE-identical device program as the unwrapped
+    # path — admission decides WHETHER a row is evaluated, never HOW
+    import time as _time
+
+    import access_control_srv_tpu.srv.admission as adm_mod
+    from access_control_srv_tpu.srv.admission import AdmissionController
+
+    adm_src = open(adm_mod.__file__).read()
+    adm_imports_jax = re.search(r"^\s*(import|from)\s+jax\b", adm_src, re.M)
+    controller = AdmissionController(enabled=True)
+    adm_reqs = [_d_request(k) for k in range(12)]
+    admitted_all = True
+    far_deadline = _time.monotonic() + 3600.0
+    for req in adm_reqs:
+        shed = controller.admit("interactive", far_deadline)
+        admitted_all = admitted_all and shed is None
+        req._deadline = far_deadline
+    controller.release("interactive", len(adm_reqs))
+    controller.observe_batch("interactive", 0.004, len(adm_reqs))
+    batch_admitted = encode_requests(adm_reqs, hybrid_d._compiled)
+    hlo_admitted = _lower_dyn(hybrid_d._compiled, reqs=adm_reqs)
+    admission_ok = (
+        admitted_all
+        and not adm_imports_jax
+        and bool(batch_admitted.eligible.all())
+        and hlo_admitted == hlo_patched     # byte-identical device program
+    )
+    results.append({
+        "kernel": "admission-zero-device-ops",
+        "ok": bool(admission_ok),
+        "imports_jax": bool(adm_imports_jax),
+        "hlo_identical": hlo_admitted == hlo_patched,
+        "note": ("admission-wrapped batch (enabled controller, deadlines "
+                 "attached, admit/release + EWMA observed) lowers to the "
+                 "BYTE-identical device program as the unwrapped path; "
+                 "srv/admission.py never imports jax — shedding and "
+                 "deadline math are host-side by construction"),
     })
 
     verdict = {
