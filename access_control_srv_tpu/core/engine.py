@@ -273,6 +273,7 @@ class AccessController:
                                 EffectEvaluation(
                                     effect=policy.effect,
                                     evaluation_cacheable=policy.evaluation_cacheable,
+                                    source=policy.id,
                                 )
                             )
                         else:
@@ -371,6 +372,7 @@ class AccessController:
                                             EffectEvaluation(
                                                 effect=rule.effect,
                                                 evaluation_cacheable=evaluation_cacheable,
+                                                source=rule.id,
                                             )
                                         )
 
@@ -390,12 +392,16 @@ class AccessController:
                 operation_status=OperationStatus(),
             )
 
-        return Response(
+        response = Response(
             decision=Decision.from_effect(effect.effect),
             obligations=obligations,
             evaluation_cacheable=effect.evaluation_cacheable,
             operation_status=OperationStatus(),
         )
+        # deciding-rule provenance for the decision-audit log (an
+        # out-of-band attribute, never serialized to the wire)
+        response._rule_id = effect.source
+        return response
 
     def what_is_allowed(self, request: Request) -> ReverseQuery:
         """Reverse query: applicable policy tree + masking obligations
@@ -872,24 +878,32 @@ class AccessController:
         """First DENY wins, else the last effect (reference: :846-862)."""
         effect = None
         evaluation_cacheable = None
+        source = None
         for e in effects or []:
             effect = e.effect
             evaluation_cacheable = e.evaluation_cacheable
+            source = e.source
             if e.effect == Effect.DENY:
                 break
-        return EffectEvaluation(effect=effect, evaluation_cacheable=evaluation_cacheable)
+        return EffectEvaluation(effect=effect,
+                                evaluation_cacheable=evaluation_cacheable,
+                                source=source)
 
     @staticmethod
     def permit_overrides(effects: list[EffectEvaluation]) -> EffectEvaluation:
         """First PERMIT wins, else the last effect (reference: :868-884)."""
         effect = None
         evaluation_cacheable = None
+        source = None
         for e in effects or []:
             effect = e.effect
             evaluation_cacheable = e.evaluation_cacheable
+            source = e.source
             if e.effect == Effect.PERMIT:
                 break
-        return EffectEvaluation(effect=effect, evaluation_cacheable=evaluation_cacheable)
+        return EffectEvaluation(effect=effect,
+                                evaluation_cacheable=evaluation_cacheable,
+                                source=source)
 
     @staticmethod
     def first_applicable(effects: list[EffectEvaluation]) -> EffectEvaluation:

@@ -186,10 +186,16 @@ class Request:
 @dataclass
 class EffectEvaluation:
     """A collected effect + cacheability marker
-    (reference: src/core/interfaces.ts EffectEvaluation)."""
+    (reference: src/core/interfaces.ts EffectEvaluation).
+
+    ``source`` carries the id of the rule (or no-rules policy) that
+    produced the effect; the combining algorithms propagate the winning
+    evaluation's source so the decision-audit log can name the deciding
+    rule on the host path.  It never influences the decision itself."""
 
     effect: Optional[str] = None
     evaluation_cacheable: Optional[bool] = None
+    source: Optional[str] = None
 
 
 @dataclass

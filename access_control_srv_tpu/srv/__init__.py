@@ -20,6 +20,7 @@ from .broker import (
     SocketSubjectCache,
 )
 from .evaluator import HybridEvaluator
+from .tracing import Observability, Span, StageTracer
 from .store import PolicyStore, ResourceService
 from .service import AccessControlService
 from .command import CommandInterface
@@ -42,6 +43,9 @@ __all__ = [
     "SocketOffsetStore",
     "SocketSubjectCache",
     "HybridEvaluator",
+    "Observability",
+    "Span",
+    "StageTracer",
     "PolicyStore",
     "ResourceService",
     "AccessControlService",

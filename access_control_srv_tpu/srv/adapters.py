@@ -145,6 +145,12 @@ class GraphQLAdapter(ResourceAdapter):
     ):
         self.url = url
         self.logger = logger
+        # rate-limited retry warnings: a down upstream under overload
+        # retries on every context-query row — unbounded, the masking
+        # logger becomes the bottleneck (srv/telemetry.SampledLogger)
+        from .telemetry import SampledLogger
+
+        self._slog = SampledLogger(logger)
         self.client_opts = client_opts or {}
         self.timeout_s = float(
             timeout_s
@@ -294,12 +300,12 @@ class GraphQLAdapter(ResourceAdapter):
                     # the remaining budget cannot cover backoff + another
                     # attempt: surface the failure now
                     raise
-                if self.logger:
-                    self.logger.warning(
-                        "transient context-query failure (%s); retry %d/%d "
-                        "in %.0f ms", code, attempt + 1, self.retry_count,
-                        delay * 1e3,
-                    )
+                self._slog.warning(
+                    "adapter-retry",
+                    "transient context-query failure (%s); retry %d/%d "
+                    "in %.0f ms", code, attempt + 1, self.retry_count,
+                    delay * 1e3,
+                )
                 time.sleep(delay)
                 attempt += 1
 

@@ -60,12 +60,17 @@ class MicroBatcher:
         max_batch: int = 4096,
         min_kernel_batch: int = 8,
         admission: Optional[AdmissionController] = None,
+        observability=None,
     ):
         self.evaluator = evaluator
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self.min_kernel_batch = min_kernel_batch
         self.admission = admission
+        # observability hub (srv/tracing.Observability): records the
+        # admission and queue-wait stages.  None keeps submit/dispatch on
+        # the exact pre-observability path.
+        self.obs = observability
         # queue items are (request, future, deadline) — deadline is an
         # absolute monotonic instant or None
         self._queue: "queue.Queue[tuple[Request, Future, Optional[float]]]" \
@@ -160,6 +165,8 @@ class MicroBatcher:
         # fingerprint is stable.
         cache = getattr(self.evaluator, "decision_cache", None)
         if cache is not None and cache.enabled:
+            obs_tracer = self.obs.tracer if self.obs is not None else None
+            t_cache = time.perf_counter() if obs_tracer is not None else 0.0
             engine = getattr(self.evaluator, "engine", None)
             urns = getattr(engine, "urns", None)
             subject_urn = (urns.get("subjectID") if urns else "") or ""
@@ -168,16 +175,36 @@ class MicroBatcher:
                 count = getattr(self.evaluator, "_count_path", None)
                 if count is not None:
                     count("cache-hit", 1)
+                if obs_tracer is not None:
+                    from .tracing import STAGE_CACHE
+
+                    obs_tracer.record(getattr(request, "_span", None),
+                                      STAGE_CACHE,
+                                      time.perf_counter() - t_cache)
+                    hit._path = "cache-hit"
                 future.set_result(hit)
                 return future
         if self._stopping:
             future.set_result(self._shutdown_result(INTERACTIVE))
             return future
+        tracer = self.obs.tracer if self.obs is not None else None
         if self.admission is not None:
+            t0 = time.perf_counter() if tracer is not None else 0.0
             shed = self.admission.admit(INTERACTIVE, deadline)
+            if tracer is not None:
+                from .tracing import STAGE_ADMISSION
+
+                tracer.record(getattr(request, "_span", None),
+                              STAGE_ADMISSION, time.perf_counter() - t0)
             if shed is not None:
                 future.set_result(shed)
                 return future
+        if tracer is not None:
+            # queue-wait start: closed at collection in _dispatch_*
+            request._t_enqueue = time.perf_counter()
+            span = getattr(request, "_span", None)
+            if span is not None:
+                span.mark_enqueue()
         self._queue.put((request, future, deadline))
         return future
 
@@ -300,6 +327,16 @@ class MicroBatcher:
             batch = self._drop_expired(batch)
             if not batch:
                 return
+        tracer = self.obs.tracer if self.obs is not None else None
+        if tracer is not None:
+            from .tracing import STAGE_QUEUE_WAIT
+
+            now = time.perf_counter()
+            for request, _, _ in batch:
+                t_enqueue = getattr(request, "_t_enqueue", None)
+                if t_enqueue is not None:
+                    tracer.record(getattr(request, "_span", None),
+                                  STAGE_QUEUE_WAIT, now - t_enqueue)
         # host-side eligibility pipeline for THIS batch runs on the
         # collector thread while the PREVIOUS batch is still evaluating
         # on the eval worker — token resolution / HR rendezvous latency

@@ -17,13 +17,15 @@ from .. import __version__
 
 class CommandInterface:
     def __init__(self, cfg, service, store=None, bus=None, cache=None,
-                 decision_cache=None, admission=None, logger=None):
+                 decision_cache=None, admission=None, observability=None,
+                 logger=None):
         self.cfg = cfg
         self.service = service
         self.store = store
         self.cache = cache
         self.decision_cache = decision_cache
         self.admission = admission
+        self.observability = observability
         self.logger = logger
         self.api_key: Optional[str] = None
         self.start_time = time.time()
@@ -59,6 +61,7 @@ class CommandInterface:
             "flush_cache": self.flush_cache,
             "set_api_key": self.set_api_key,
             "metrics": self.metrics,
+            "traces": self.traces,
             "profile": self.profile,
         }.get(name)
         if handler is None:
@@ -99,6 +102,29 @@ class CommandInterface:
         detail = {}
         try:
             detail["policy_sets"] = len(self.service.engine.policy_sets)
+            telemetry = getattr(self.service, "telemetry", None)
+            if telemetry is not None:
+                # interpolated percentile estimates, not raw bucket
+                # arrays — the operator-facing latency signal
+                latency = {}
+                for name, hist in (
+                    ("is_allowed", telemetry.is_allowed_latency),
+                    ("what_is_allowed", telemetry.what_is_allowed_latency),
+                    ("batch", telemetry.batch_latency),
+                ):
+                    snap = hist.snapshot()
+                    if snap["count"]:
+                        latency[name] = {
+                            "count": snap["count"],
+                            "p50_ms": round(snap["p50_s"] * 1e3, 3)
+                            if snap["p50_s"] is not None else None,
+                            "p95_ms": round(snap["p95_s"] * 1e3, 3)
+                            if snap["p95_s"] is not None else None,
+                            "p99_ms": round(snap["p99_s"] * 1e3, 3)
+                            if snap["p99_s"] is not None else None,
+                        }
+                if latency:
+                    detail["latency"] = latency
             evaluator = self.service.evaluator
             if evaluator is not None:
                 detail["kernel_active"] = evaluator.kernel_active
@@ -186,11 +212,31 @@ class CommandInterface:
 
     def metrics(self, payload: dict) -> dict:
         """Latency histograms + decision/path counters (SURVEY.md §5:
-        request-latency histograms at the serving shell)."""
+        request-latency histograms at the serving shell).  Payload
+        ``{"format": "prometheus"}`` renders the same registry in
+        Prometheus text exposition format — the command-interface twin of
+        the optional /metrics endpoint (observability:metrics_http)."""
         telemetry = getattr(self.service, "telemetry", None)
         if telemetry is None:
             return {"error": "telemetry not wired"}
+        if (payload or {}).get("format") == "prometheus":
+            from .telemetry import MetricsRegistry
+
+            return {
+                "content_type": MetricsRegistry.CONTENT_TYPE,
+                "body": telemetry.prometheus(),
+            }
         return telemetry.snapshot()
+
+    def traces(self, payload: dict) -> dict:
+        """Recent sampled span trees (observability:tracing, bounded ring
+        buffer): ``{"n": K}`` limits to the most recent K."""
+        obs = self.observability
+        if obs is None or obs.tracer is None:
+            return {"error": "tracing not enabled "
+                             "(observability config absent or off)"}
+        n = (payload or {}).get("n")
+        return {"traces": obs.tracer.traces(int(n) if n else None)}
 
     def profile(self, payload: dict) -> dict:
         """JAX profiler control (SURVEY section 5 tracing substitute): an

@@ -90,6 +90,23 @@ DEFAULT_CONFIG: dict = {
             "half_open_probes": 2,
         },
     },
+    # observability (srv/tracing.py, docs/OBSERVABILITY.md).  Disabled by
+    # default: with enabled false (or the block absent) NO tracer/audit/
+    # exporter object is built and the serving path is byte-identical to
+    # pre-observability behavior (tests/test_tracing.py differential).
+    # Enabled: stage-span tracing fills Telemetry.stages (Prometheus
+    # acs_stage_duration_seconds), sample_rate retains that fraction of
+    # requests as full span trees (x-acs-trace-id metadata forces
+    # sampling), audit_log.path turns on the sampled JSONL decision-audit
+    # sink, metrics_http serves GET /metrics in Prometheus text format.
+    "observability": {
+        "enabled": False,
+        "tracing": {"enabled": True, "sample_rate": 0.01,
+                    "max_traces": 256},
+        "metrics_http": {"enabled": False, "host": "127.0.0.1",
+                         "port": 9464},
+        "audit_log": {"path": None, "sample_rate": 0.01},
+    },
     "logger": {"maskFields": ["password", "token"]},
 }
 

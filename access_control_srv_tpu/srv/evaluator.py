@@ -56,12 +56,23 @@ class HybridEvaluator:
         model_axis: str | None = None,
         decision_cache=None,
         delta_enabled: bool = True,
+        observability=None,
     ):
         self.engine = engine
         self.backend = backend
         self.logger = logger
         self.telemetry = telemetry
         self.async_compile = async_compile
+        # observability hub (srv/tracing.Observability): stage-span
+        # tracing + audit attribution.  None (the default) keeps every
+        # instrumentation site on the exact pre-observability path.
+        self.obs = observability
+        # rate-limited hot-path logging: the per-row warning sites
+        # (token-unresolved, oracle fallback) must not turn the masking
+        # logger into the bottleneck when an upstream is down under load
+        from .telemetry import SampledLogger
+
+        self._slog = SampledLogger(logger)
         # server-side decision cache (srv/decision_cache.py): consulted
         # batch-wide BEFORE encode so hit rows skip both the device
         # round-trip and the oracle walk; written through from every miss
@@ -449,17 +460,32 @@ class HybridEvaluator:
     def native_active(self) -> bool:
         return self._native_encoder is not None
 
-    def is_allowed_batch_wire(self, messages: list[bytes]):
+    def is_allowed_batch_wire(self, messages: list[bytes], span=None):
         """Native fast path: serialized acstpu.Request messages -> per-row
         (decision, cacheable, status, eligible).  Returns None when the
-        native encoder is unavailable (caller falls back to the pb path)."""
+        native encoder is unavailable (caller falls back to the pb path).
+        ``span`` is the RPC-level span from the transport (the native
+        path has no Request objects to carry per-row spans)."""
         with self._lock:
             kernel = self._kernel
             encoder = self._native_encoder
         if kernel is None or encoder is None or self.backend == "oracle":
             return None
+        tracer = self.obs.tracer if self.obs is not None else None
+        t_stage = time.perf_counter() if tracer is not None else 0.0
         batch = encoder.encode_wire(messages)
+        if tracer is not None:
+            from .tracing import STAGE_WIRE_ENCODE
+
+            now = time.perf_counter()
+            tracer.record(span, STAGE_WIRE_ENCODE, now - t_stage)
+            t_stage = now
         decision, cacheable, status = kernel.evaluate(batch)
+        if tracer is not None:
+            from .tracing import STAGE_DEVICE
+
+            tracer.record(span, STAGE_DEVICE,
+                          time.perf_counter() - t_stage)
         if batch.overcap is not None and batch.overcap.any():
             # adaptive caps, native path: rows that overflowed the floor
             # shapes re-encode natively at the ceiling (one extra native
@@ -498,6 +524,24 @@ class HybridEvaluator:
     # ------------------------------------------------- host-side pipeline
 
     def prepare_batch(self, requests: list) -> None:
+        """Stage-traced wrapper over the eligibility pipeline: records
+        the ``prepare`` stage (token resolution + HR rendezvous wall
+        time) when the batch actually had unresolved token rows — the
+        idempotent re-invocation from is_allowed_batch after the
+        batcher already prepared is a no-op and records nothing."""
+        tracer = self.obs.tracer if self.obs is not None else None
+        if tracer is None:
+            self._prepare_batch(requests)
+            return
+        t0 = time.perf_counter()
+        did_work = self._prepare_batch(requests)
+        if did_work:
+            from .tracing import STAGE_PREPARE
+
+            tracer.fan_out(requests, STAGE_PREPARE,
+                           time.perf_counter() - t0)
+
+    def _prepare_batch(self, requests: list) -> bool:
         """Host-side eligibility pipeline, stage (a): batch-resolve every
         distinct ``subject.token`` through the identity client (one RPC per
         distinct token — the TTL'd resolution cache makes repeats across
@@ -527,7 +571,7 @@ class HybridEvaluator:
             if token:
                 pending.append((request, token))
         if not pending:
-            return
+            return False
 
         client = engine.identity_client
 
@@ -535,10 +579,13 @@ class HybridEvaluator:
             try:
                 return client.find_by_token(token)
             except Exception as err:  # noqa: BLE001 — fail the row closed
-                if self.logger:
-                    self.logger.warning(
-                        "batch token resolution failed: %s", err
-                    )
+                # sampled: a down identity service under overload fires
+                # this once per distinct token per batch — unbounded, it
+                # would make the logger the bottleneck
+                self._slog.warning(
+                    "token-resolution",
+                    "batch token resolution failed: %s", err,
+                )
                 return None
 
         by_token: dict[str, list] = {}
@@ -587,7 +634,7 @@ class HybridEvaluator:
         # the same per-row outcome the reference's individual waits produce.
         provider = engine.hr_scope_provider
         if provider is None:
-            return
+            return True
         groups: dict[str, list] = {}
         for request, _ in pending:
             if not getattr(request, "_token_resolved", False):
@@ -599,7 +646,7 @@ class HybridEvaluator:
             if key is not None:
                 groups.setdefault(key, []).append(request)
         if not groups:
-            return
+            return True
         firsts = [rows[0] for rows in groups.values()]
         if len(firsts) > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -618,6 +665,7 @@ class HybridEvaluator:
                     cached = True  # fall through to the normal path
                 if cached:
                     engine.create_hr_scope(request.context)
+        return True
 
     # ------------------------------------------------------------ evaluation
 
@@ -625,6 +673,7 @@ class HybridEvaluator:
         """Single-request path: the oracle wins below batch sizes where the
         device round-trip pays off.  The decision cache is consulted first
         — a warm cacheable request never pays the walk."""
+        tracer = self.obs.tracer if self.obs is not None else None
         cache = self.decision_cache
         if cache is not None and cache.enabled:
             # epoch snapshot BEFORE the walk reads the tree: if a CRUD /
@@ -633,18 +682,40 @@ class HybridEvaluator:
             # instead of serving an old-tree decision as fresh
             epoch = cache.epoch
             self.engine.prepare_context(request)
+            t0 = time.perf_counter() if tracer is not None else 0.0
             key = cache.fingerprint(
                 request, self.engine.urns.get("subjectID") or ""
             )
             hit = cache.get(key)
             if hit is not None:
                 self._count_path("cache-hit", 1)
+                if tracer is not None:
+                    from .tracing import STAGE_CACHE
+
+                    tracer.record(getattr(request, "_span", None),
+                                  STAGE_CACHE, time.perf_counter() - t0)
+                    hit._path = "cache-hit"
                 return hit
-            response = self._oracle_is_allowed(request)
+            response = self._traced_oracle(request, tracer)
             cache.put(key, response, epoch=epoch,
                       features=self._request_features(request))
             return response
-        return self._oracle_is_allowed(request)
+        return self._traced_oracle(request, tracer)
+
+    def _traced_oracle(self, request, tracer) -> Response:
+        """Oracle walk with the ``oracle`` stage recorded (and the
+        serving-path attribute stamped for the audit log) when the
+        observability hub is wired; the bare walk otherwise."""
+        if tracer is None:
+            return self._oracle_is_allowed(request)
+        from .tracing import STAGE_ORACLE
+
+        t0 = time.perf_counter()
+        response = self._oracle_is_allowed(request)
+        tracer.record(getattr(request, "_span", None), STAGE_ORACLE,
+                      time.perf_counter() - t0)
+        response._path = "oracle"
+        return response
 
     def _request_features(self, request):
         """Candidate-signature features for scoped cache invalidation
@@ -781,6 +852,8 @@ class HybridEvaluator:
         # reads the tree: rows whose evaluation spans a concurrent epoch
         # bump are written through born-stale (see DecisionCache.put)
         epoch = cache.epoch
+        tracer = self.obs.tracer if self.obs is not None else None
+        t_cache = time.perf_counter() if tracer is not None else 0.0
         responses: list[Optional[Response]] = [None] * len(requests)
         keys: list = [None] * len(requests)
         misses: list[int] = []
@@ -795,6 +868,16 @@ class HybridEvaluator:
                 responses[b] = hit
             else:
                 misses.append(b)
+        if tracer is not None and len(misses) < len(requests):
+            from .tracing import STAGE_CACHE
+
+            tracer.fan_out(
+                [r for b, r in enumerate(requests) if responses[b] is not None],
+                STAGE_CACHE, time.perf_counter() - t_cache,
+            )
+            for response in responses:
+                if response is not None:
+                    response._path = "cache-hit"
         self._count_path("cache-hit", len(requests) - len(misses))
         if misses:
             computed = self._is_allowed_batch_uncached(
@@ -848,10 +931,25 @@ class HybridEvaluator:
         return self._eval_encoded(kernel, compiled, requests, None)
 
     def _eval_encoded(self, kernel, compiled, requests: list, caps):
+        tracer = self.obs.tracer if self.obs is not None else None
+        t_stage = time.perf_counter() if tracer is not None else 0.0
         batch = encode_requests(
             requests, compiled, self.engine.resource_adapter, caps=caps
         )
+        if tracer is not None:
+            from .tracing import STAGE_DEVICE, STAGE_ENCODE
+
+            now = time.perf_counter()
+            tracer.fan_out(requests, STAGE_ENCODE, now - t_stage)
+            t_stage = now
         decision, cacheable, status = kernel.evaluate(batch)
+        if tracer is not None:
+            # the kernel's evaluate() spans H2D transfer, device dispatch
+            # and the D2H fetch — attributed as one ``device`` stage (the
+            # host/device boundary; docs/OBSERVABILITY.md)
+            now = time.perf_counter()
+            tracer.fan_out(requests, STAGE_DEVICE, now - t_stage)
+            t_stage = now
         n_oracle = sum(
             1 for b in range(len(requests))
             if not batch.eligible[b] or status[b] != 200
@@ -902,7 +1000,25 @@ class HybridEvaluator:
                     operation_status=OperationStatus(),
                 )
             )
+        if tracer is not None:
+            from .tracing import STAGE_DECODE
+
+            now = time.perf_counter()
+            tracer.fan_out(requests, STAGE_DECODE, now - t_stage)
+            t_stage = now
+            for resp in responses:
+                if resp is not None:
+                    resp._path = "kernel"
         if oracle_pending:
+            if len(requests) >= 8:
+                # sampled: a down adapter under overload degrades whole
+                # batches to the oracle — the signal matters, the
+                # per-batch record flood does not
+                self._slog.warning(
+                    "oracle-fallback",
+                    "%d/%d batch rows fell back to the scalar oracle",
+                    len(oracle_pending), len(requests),
+                )
             rows = [req for _, req in oracle_pending]
             adapter = self.engine.resource_adapter
             if adapter is not None and len(rows) > 1:
@@ -921,6 +1037,13 @@ class HybridEvaluator:
                     results = list(pool.map(self._oracle_is_allowed, rows))
             else:
                 results = [self._oracle_is_allowed(r) for r in rows]
+            if tracer is not None:
+                from .tracing import STAGE_ORACLE
+
+                tracer.fan_out(rows, STAGE_ORACLE,
+                               time.perf_counter() - t_stage)
+                for response in results:
+                    response._path = "oracle"
             for (slot, _), response in zip(oracle_pending, results):
                 responses[slot] = response
         return responses

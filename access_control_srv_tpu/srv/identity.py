@@ -296,6 +296,12 @@ class GrpcIdentityClient:
         self.address = address
         self.timeout = timeout
         self.logger = logger
+        # rate-limited failure warnings: a down identity service fires
+        # this once per cache-missing token — unbounded under overload,
+        # the masking logger becomes the bottleneck
+        from .telemetry import SampledLogger
+
+        self._slog = SampledLogger(logger)
         self.channel = grpc.insecure_channel(address)
         self._call = self.channel.unary_unary(
             "/acstpu.IdentityService/FindByToken",
@@ -330,10 +336,10 @@ class GrpcIdentityClient:
                 timeout=self.timeout,
             )
         except Exception as err:
-            if self.logger:
-                self.logger.warning(
-                    "identity findByToken failed: %s", err
-                )
+            self._slog.warning(
+                "identity-resolution",
+                "identity findByToken failed: %s", err,
+            )
             if self.breaker is not None:
                 self.breaker.record_failure()
             # 5xx: never cached, so recovery after an outage is immediate

@@ -65,16 +65,61 @@ def coerce_request(request: Any) -> Request:
 
 class AccessControlService:
     def __init__(self, cfg, engine: AccessController, evaluator=None,
-                 store=None, logger=None, telemetry=None):
+                 store=None, logger=None, telemetry=None,
+                 observability=None):
         self.cfg = cfg
         self.engine = engine
         self.evaluator = evaluator
         self.store = store
         self.logger = logger
         self.telemetry = telemetry
+        # observability hub (srv/tracing.Observability): span fallback
+        # creation for non-transport callers + the sampled decision-audit
+        # log.  None keeps the facade byte-identical to pre-observability.
+        self.obs = observability
         # when set (Worker wires it), concurrent single isAllowed calls are
         # coalesced into kernel batches instead of hitting the oracle 1-by-1
         self.batcher = None
+
+    def _observed_request(self, req):
+        """(span, own_span): the transport-attached span if any, else a
+        freshly sampled one owned (and finished) by this facade — so
+        non-gRPC callers trace too."""
+        obs = self.obs
+        if obs is None or obs.tracer is None:
+            return None, False
+        span = getattr(req, "_span", None)
+        if span is not None:
+            return span, False
+        if getattr(req, "_sampling_done", False):
+            # the transport already rolled the sampling dice for this
+            # request — re-rolling here would skew the effective rate
+            return None, False
+        span = obs.tracer.start_span()
+        if span is not None:
+            req._span = span
+            return span, True
+        return None, False
+
+    def _finish_observed(self, req, response, span, own_span) -> None:
+        """Audit-log the decision (sampled) and finish a facade-owned
+        span; transport-owned spans finish at the transport after the
+        serialize stage."""
+        obs = self.obs
+        if obs is None:
+            return
+        if obs.audit is not None:
+            try:
+                obs.audit.maybe_record(
+                    req, response,
+                    span.trace_id if span is not None else None,
+                )
+            except Exception:  # noqa: BLE001 — audit must never fail serving
+                if self.logger:
+                    self.logger.exception("decision audit record failed")
+        if own_span and obs.tracer is not None:
+            obs.tracer.finish(span, decision=response.decision,
+                              code=response.operation_status.code)
 
     def _observe(self, histogram_name, t0, decisions=()):
         """One helper for success AND deny-on-exception paths so served
@@ -97,8 +142,12 @@ class AccessControlService:
         the request as ``_deadline`` for deadline-aware adapter retries
         and, with admission enabled, gates the batcher submit."""
         t0 = time.perf_counter()
+        req = request
+        span = None
+        own_span = False
         try:
             req = coerce_request(request)
+            span, own_span = self._observed_request(req)
             if deadline is not None:
                 req._deadline = deadline
             if self.batcher is not None:
@@ -119,13 +168,14 @@ class AccessControlService:
             else:
                 response = self.engine.is_allowed(req)
             self._observe("is_allowed_latency", t0, (response.decision,))
+            self._finish_observed(req, response, span, own_span)
             return response
         except Exception as err:
             if self.logger:
                 self.logger.exception("isAllowed failed")
             self._observe("is_allowed_latency", t0, (Decision.DENY,))
             code = getattr(err, "code", 500)
-            return Response(
+            response = Response(
                 decision=Decision.DENY,
                 obligations=[],
                 evaluation_cacheable=False,
@@ -134,6 +184,8 @@ class AccessControlService:
                     message=str(err) or "Unknown Error!",
                 ),
             )
+            self._finish_observed(req, response, span, own_span)
+            return response
 
     def is_allowed_batch(
         self, requests: list, observe: bool = True,
@@ -168,6 +220,16 @@ class AccessControlService:
                 responses = [self.engine.is_allowed(r) for r in reqs]
             _observe("batch_latency", t0,
                      [r.decision for r in responses])
+            if self.obs is not None and self.obs.audit is not None:
+                for row_req, row_resp in zip(reqs, responses):
+                    row_span = getattr(row_req, "_span", None)
+                    try:
+                        self.obs.audit.maybe_record(
+                            row_req, row_resp,
+                            row_span.trace_id if row_span else None,
+                        )
+                    except Exception:  # noqa: BLE001 — never fail serving
+                        pass
             return responses
         except Exception as err:
             # same deny-on-exception contract as the single-request path

@@ -13,12 +13,13 @@ serves from ``health_check``/``metrics``.
 
 from __future__ import annotations
 
+import copy
 import logging
 import math
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 # histogram buckets: upper bounds in seconds (log-spaced ~x4 from 50us to 50s)
 _BUCKETS = [
@@ -133,6 +134,38 @@ def make_logger(name: str = "access-control-srv-tpu",
     return logger
 
 
+def estimate_percentiles(
+    bounds: list, counts: list, qs: tuple = (0.5, 0.95, 0.99)
+) -> list:
+    """Bucket-interpolated percentile estimates: linear interpolation of
+    the quantile position inside its bucket, between the previous bound
+    and the bucket's own upper bound (0 below the first bucket; the inf
+    bucket clamps to the last finite bound — the estimate cannot invent
+    mass past what the histogram resolved)."""
+    total = sum(counts)
+    if total == 0:
+        return [None] * len(qs)
+    out = []
+    for q in qs:
+        rank = q * total
+        cumulative = 0
+        value = None
+        for idx, (bound, count) in enumerate(zip(bounds, counts)):
+            prev_cum = cumulative
+            cumulative += count
+            if cumulative >= rank:
+                lo = bounds[idx - 1] if idx else 0.0
+                hi = bound
+                if math.isinf(hi):
+                    value = float(lo)
+                    break
+                frac = (rank - prev_cum) / count if count else 1.0
+                value = float(lo + (hi - lo) * frac)
+                break
+        out.append(value)
+    return out
+
+
 class Histogram:
     """Fixed-bucket latency histogram; thread-safe, O(1) observe."""
 
@@ -156,10 +189,16 @@ class Histogram:
         with self._lock:
             counts = list(self._counts)
             total, n = self._sum, self._n
+        p50, p95, p99 = estimate_percentiles(_BUCKETS, counts)
         out = {
             "count": n,
             "sum_s": round(total, 6),
             "mean_s": round(total / n, 6) if n else None,
+            # bucket-interpolated estimates (operator-facing; raw buckets
+            # below remain the ground truth)
+            "p50_s": round(p50, 6) if p50 is not None else None,
+            "p95_s": round(p95, 6) if p95 is not None else None,
+            "p99_s": round(p99, 6) if p99 is not None else None,
             "buckets": {},
         }
         cumulative = 0
@@ -200,10 +239,14 @@ class ValueHistogram:
         with self._lock:
             counts = list(self._counts)
             total, n, peak = self._sum, self._n, self._max
+        p50, p95, p99 = estimate_percentiles(self.BOUNDS, counts)
         out = {
             "count": n,
             "mean": round(total / n, 3) if n else None,
             "max": peak,
+            "p50": round(p50, 3) if p50 is not None else None,
+            "p95": round(p95, 3) if p95 is not None else None,
+            "p99": round(p99, 3) if p99 is not None else None,
             "buckets": {},
         }
         cumulative = 0
@@ -232,8 +275,226 @@ class Counter:
             return dict(self._values)
 
 
+class SampledLogger:
+    """Rate-limited wrapper for hot-path log sites: at most
+    ``max_per_interval`` records per key per ``interval_s`` window; the
+    overflow is counted and flushed as ONE summary line when the window
+    rolls.  A down upstream under overload turns per-row warnings
+    (token-unresolved, oracle fallback, adapter retry) into tens of
+    thousands of records per second — enough to make the masking logger
+    itself the serving bottleneck; this caps the worst case at
+    ``max_per_interval + 1`` records per key per window regardless of
+    offered load.  Thread-safe; the fast (suppressed) path is one lock +
+    one dict update, no formatting."""
+
+    def __init__(self, logger, max_per_interval: int = 5,
+                 interval_s: float = 10.0, time_fn=time.monotonic):
+        self.logger = logger
+        self.max_per_interval = int(max_per_interval)
+        self.interval_s = float(interval_s)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        # key -> [window_start, emitted_in_window, suppressed_in_window]
+        self._state: dict[str, list] = {}
+
+    def _gate(self, key: str) -> tuple[bool, int]:
+        """(emit_now, suppressed_to_report): whether THIS record may log,
+        and how many suppressed records the rolled window accumulated."""
+        now = self._time()
+        with self._lock:
+            state = self._state.get(key)
+            if state is None or now - state[0] >= self.interval_s:
+                rolled = state[2] if state else 0
+                self._state[key] = [now, 1, 0]
+                return True, rolled
+            if state[1] < self.max_per_interval:
+                state[1] += 1
+                return True, 0
+            state[2] += 1
+            return False, 0
+
+    def _log(self, level: int, key: str, msg: str, *args, **kwargs) -> None:
+        if self.logger is None:
+            return
+        emit, rolled = self._gate(key)
+        if rolled:
+            self.logger.log(
+                level,
+                "suppressed %d '%s' records in the last %.0fs "
+                "(rate-limited hot-path logging)",
+                rolled, key, self.interval_s,
+            )
+        if emit:
+            self.logger.log(level, msg, *args, **kwargs)
+
+    def warning(self, key: str, msg: str, *args, **kwargs) -> None:
+        self._log(logging.WARNING, key, msg, *args, **kwargs)
+
+    def info(self, key: str, msg: str, *args, **kwargs) -> None:
+        self._log(logging.INFO, key, msg, *args, **kwargs)
+
+    def suppressed(self, key: str) -> int:
+        with self._lock:
+            state = self._state.get(key)
+            return state[2] if state else 0
+
+
+# ------------------------------------------------- Prometheus exposition
+
+def _prom_escape(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_bucket_label(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else repr(float(bound))
+
+
+class MetricsRegistry:
+    """Named metric registry rendering the Prometheus text exposition
+    format (version 0.0.4).  Entries hold LIVE references to the
+    Counter/Histogram objects (or zero-arg callables for gauges and for
+    late-bound histogram groups like the stage-tracer taxonomy), so
+    ``render()`` always reflects the current state — there is no
+    separate scrape-time collection step to keep in sync."""
+
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def __init__(self):
+        self._entries: list[tuple] = []  # (kind, name, help, payload)
+
+    def counter(self, name: str, help_text: str, counter: Counter,
+                label: str = "key") -> None:
+        self._entries.append(("counter", name, help_text, (counter, label)))
+
+    def histogram(self, name: str, help_text: str, histogram) -> None:
+        self._entries.append(("histogram", name, help_text,
+                              (lambda: {None: histogram}, None)))
+
+    def histogram_group(self, name: str, help_text: str,
+                        group_fn: Callable[[], dict], label: str) -> None:
+        """A family of histograms under one metric name, one label value
+        per histogram (``group_fn`` returns {label_value: Histogram} and
+        is consulted at render time — late-bound members appear)."""
+        self._entries.append(("histogram", name, help_text,
+                              (group_fn, label)))
+
+    def gauge(self, name: str, help_text: str,
+              value_fn: Callable[[], float]) -> None:
+        self._entries.append(("gauge", name, help_text, value_fn))
+
+    @staticmethod
+    def _render_histogram(lines: list, name: str, histogram,
+                          label: Optional[str], label_value) -> None:
+        bounds = getattr(histogram, "BOUNDS", _BUCKETS)
+        with histogram._lock:
+            counts = list(histogram._counts)
+            total, n = histogram._sum, histogram._n
+        prefix = ""
+        if label is not None:
+            prefix = f'{label}="{_prom_escape(label_value)}",'
+        cumulative = 0
+        for bound, count in zip(bounds, counts):
+            cumulative += count
+            lines.append(
+                f'{name}_bucket{{{prefix}le="{_prom_bucket_label(bound)}"}}'
+                f" {cumulative}"
+            )
+        suffix = f'{{{label}="{_prom_escape(label_value)}"}}' \
+            if label is not None else ""
+        lines.append(f"{name}_sum{suffix} {total!r}")
+        lines.append(f"{name}_count{suffix} {n}")
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for kind, name, help_text, payload in self._entries:
+            if kind == "counter":
+                counter, label = payload
+                values = counter.snapshot()
+                if not values:
+                    continue
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                for key in sorted(values):
+                    lines.append(
+                        f'{name}{{{label}="{_prom_escape(key)}"}} '
+                        f"{values[key]}"
+                    )
+            elif kind == "histogram":
+                group_fn, label = payload
+                group = group_fn()
+                if not group:
+                    continue
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} histogram")
+                for label_value in sorted(
+                    group, key=lambda v: "" if v is None else str(v)
+                ):
+                    self._render_histogram(
+                        lines, name, group[label_value], label, label_value
+                    )
+            else:  # gauge
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {payload()!r}")
+        return "\n".join(lines) + "\n"
+
+
+class PrometheusExporter:
+    """Optional stdlib /metrics endpoint (observability:metrics_http):
+    a daemon ThreadingHTTPServer serving the registry's text exposition
+    on GET /metrics — the pull-model counterpart of the command
+    interface's ``metrics`` command (same bytes, same registry).  Port 0
+    binds an ephemeral port (tests); ``.port`` reports the bound one."""
+
+    def __init__(self, telemetry: "Telemetry", host: str = "127.0.0.1",
+                 port: int = 0, logger=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry = telemetry.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib API name
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self.send_error(404)
+                    return
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 MetricsRegistry.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log traffic
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="acs-metrics-http",
+        )
+        self._thread.start()
+        if logger is not None:
+            logger.info("metrics endpoint up",
+                        extra={"addr": f"{self.host}:{self.port}"})
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
 class Telemetry:
-    """Per-worker metrics registry wired into the service facade."""
+    """Per-worker metrics facade over a ``MetricsRegistry``: every
+    counter/histogram below is registered with a Prometheus name at
+    construction, so the full snapshot renders in text exposition format
+    (``prometheus()``) without a separate collection step — the
+    ``metrics`` command and the optional /metrics endpoint serve the
+    same registry."""
 
     def __init__(self):
         self.is_allowed_latency = Histogram()
@@ -258,7 +519,69 @@ class Telemetry:
         self.admission = Counter()
         self.admission_queue_depth = ValueHistogram()
         self.admission_budget = Histogram()
+        # per-stage pipeline durations (srv/tracing.StageTracer writes
+        # here): stage name -> Histogram.  Empty unless tracing is
+        # enabled, so the snapshot/exposition surface only grows when the
+        # operator asked for attribution.
+        self.stages: dict[str, Histogram] = {}
         self.start_time = time.time()
+        self._snapshot_lock = threading.Lock()
+        self.registry = MetricsRegistry()
+        self._register_all()
+
+    def _register_all(self) -> None:
+        reg = self.registry
+        reg.gauge("acs_uptime_seconds", "Worker uptime",
+                  lambda: round(time.time() - self.start_time, 3))
+        reg.histogram("acs_is_allowed_latency_seconds",
+                      "isAllowed end-to-end latency", self.is_allowed_latency)
+        reg.histogram("acs_what_is_allowed_latency_seconds",
+                      "whatIsAllowed end-to-end latency",
+                      self.what_is_allowed_latency)
+        reg.histogram("acs_batch_latency_seconds",
+                      "Batched isAllowed latency", self.batch_latency)
+        reg.counter("acs_decisions_total", "Decisions served by value",
+                    self.decisions, label="decision")
+        reg.counter("acs_serving_path_rows_total",
+                    "Rows served per path (kernel/oracle/native-wire/"
+                    "cache-hit/...)", self.paths, label="path")
+        reg.counter("acs_decision_cache_events_total",
+                    "Decision-cache hits/misses/evictions",
+                    self.cache, label="event")
+        reg.counter("acs_identity_cache_events_total",
+                    "Token-resolution cache events",
+                    self.identity, label="event")
+        reg.counter("acs_policy_update_events_total",
+                    "Incremental policy-update events (ops/delta.py)",
+                    self.delta, label="event")
+        reg.histogram("acs_policy_update_latency_seconds",
+                      "Mutation-to-visibility latency",
+                      self.policy_update_latency)
+        reg.counter("acs_admission_events_total",
+                    "Admission control events (srv/admission.py)",
+                    self.admission, label="event")
+        reg.histogram("acs_admission_queue_depth",
+                      "Queue depth at admit", self.admission_queue_depth)
+        reg.histogram("acs_admission_budget_seconds",
+                      "Remaining deadline budget at admit",
+                      self.admission_budget)
+        reg.histogram_group(
+            "acs_stage_duration_seconds",
+            "Per-stage pipeline duration (srv/tracing.py taxonomy)",
+            lambda: self.stages, label="stage",
+        )
+
+    def stage_histogram(self, stage: str) -> Histogram:
+        """The (lazily created) histogram for one pipeline stage."""
+        hist = self.stages.get(stage)
+        if hist is None:
+            with self._snapshot_lock:
+                hist = self.stages.setdefault(stage, Histogram())
+        return hist
+
+    def prometheus(self) -> str:
+        """The full snapshot in Prometheus text exposition format."""
+        return self.registry.render()
 
     @contextmanager
     def timed(self, histogram: Histogram):
@@ -275,25 +598,39 @@ class Telemetry:
         self.paths.inc(path, rows)
 
     def snapshot(self) -> dict:
-        return {
-            "uptime_s": round(time.time() - self.start_time, 3),
-            "is_allowed_latency": self.is_allowed_latency.snapshot(),
-            "what_is_allowed_latency": self.what_is_allowed_latency.snapshot(),
-            "batch_latency": self.batch_latency.snapshot(),
-            "decisions": self.decisions.snapshot(),
-            "paths": self.paths.snapshot(),
-            "decision_cache": self.cache.snapshot(),
-            "identity_cache": self.identity.snapshot(),
-            "policy_update": {
-                **self.delta.snapshot(),
-                "latency": self.policy_update_latency.snapshot(),
-            },
-            "admission": {
-                **self.admission.snapshot(),
-                "queue_depth": self.admission_queue_depth.snapshot(),
-                "budget_s": self.admission_budget.snapshot(),
-            },
-        }
+        # assembled under the snapshot lock and returned as a DEEP copy:
+        # concurrent `metrics`/`health_check` readers serialize their own
+        # private tree — they can never observe a dict mutating under a
+        # concurrent writer mid-json.dumps (each sub-snapshot is already
+        # a copy; the deep copy also detaches anything a future metric
+        # nests by reference)
+        with self._snapshot_lock:
+            out = {
+                "uptime_s": round(time.time() - self.start_time, 3),
+                "is_allowed_latency": self.is_allowed_latency.snapshot(),
+                "what_is_allowed_latency":
+                    self.what_is_allowed_latency.snapshot(),
+                "batch_latency": self.batch_latency.snapshot(),
+                "decisions": self.decisions.snapshot(),
+                "paths": self.paths.snapshot(),
+                "decision_cache": self.cache.snapshot(),
+                "identity_cache": self.identity.snapshot(),
+                "policy_update": {
+                    **self.delta.snapshot(),
+                    "latency": self.policy_update_latency.snapshot(),
+                },
+                "admission": {
+                    **self.admission.snapshot(),
+                    "queue_depth": self.admission_queue_depth.snapshot(),
+                    "budget_s": self.admission_budget.snapshot(),
+                },
+            }
+            if self.stages:
+                out["stages"] = {
+                    stage: hist.snapshot()
+                    for stage, hist in sorted(self.stages.items())
+                }
+            return copy.deepcopy(out)
 
 
 @contextmanager

@@ -12,6 +12,7 @@ the batched TPU evaluation path directly.
 from __future__ import annotations
 
 import json
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -29,6 +30,14 @@ from ..models.model import (
 from ..ops.compile import DECISION_NAMES
 from .admission import deadline_from_context
 from .gen import access_control_pb2 as pb
+from .tracing import (
+    STAGE_DECODE,
+    STAGE_ORACLE,
+    STAGE_SERIALIZE,
+    STAGE_TRANSPORT_PARSE,
+    echo_trace_id,
+    trace_id_from_metadata,
+)
 
 
 def split_batch_request(data: bytes) -> Optional[list[bytes]]:
@@ -395,17 +404,46 @@ class GrpcServer:
 
     def _register(self):
         worker = self.worker
+        # observability hub: None (config absent/disabled) keeps every
+        # handler on the exact pre-observability path
+        obs = getattr(worker, "obs", None)
 
         def is_allowed(request, context):
             # deadline propagation (srv/admission.py): the client's gRPC
             # deadline (or x-acs-timeout-ms metadata) becomes the
             # request's budget — rejected at submit when infeasible,
             # dropped at dispatch when expired
+            if obs is None or obs.tracer is None:
+                response = worker.service.is_allowed(
+                    request_from_pb(request),
+                    deadline=deadline_from_context(context),
+                )
+                return response_to_pb(response)
+            # traced path: span at transport receive (trace id from the
+            # x-acs-trace-id metadata key — an explicit id forces
+            # sampling), parse + serialize stages recorded here, the
+            # pipeline stages downstream; the id echoes on the trailer
+            tracer = obs.tracer
+            t0 = time.perf_counter()
+            span = tracer.start_span(trace_id_from_metadata(context))
+            req = request_from_pb(request)
+            tracer.record(span, STAGE_TRANSPORT_PARSE,
+                          time.perf_counter() - t0)
+            req._sampling_done = True
+            if span is not None:
+                req._span = span
             response = worker.service.is_allowed(
-                request_from_pb(request),
-                deadline=deadline_from_context(context),
+                req, deadline=deadline_from_context(context)
             )
-            return response_to_pb(response)
+            t_ser = time.perf_counter()
+            msg = response_to_pb(response)
+            tracer.record(span, STAGE_SERIALIZE,
+                          time.perf_counter() - t_ser)
+            if span is not None:
+                echo_trace_id(context, span.trace_id)
+                tracer.finish(span, decision=response.decision,
+                              code=response.operation_status.code)
+            return msg
 
         def is_allowed_batch(raw, context):
             # raw BatchRequest bytes: try the native wire fast path (C++
@@ -415,15 +453,37 @@ class GrpcServer:
 
             t0 = _time.perf_counter()
             deadline = deadline_from_context(context)
+            tracer = obs.tracer if obs is not None else None
+            span = None
+            t_stage = t0
+            if tracer is not None:
+                # one RPC-level span for the whole batch: batch stages
+                # fan into it once (srv/tracing.StageTracer.fan_out)
+                span = tracer.start_span(trace_id_from_metadata(context))
             messages = split_batch_request(raw)
+            if tracer is not None:
+                now = _time.perf_counter()
+                tracer.record(span, STAGE_TRANSPORT_PARSE, now - t_stage)
+                t_stage = now
+
+            def finish_rpc(payload: bytes) -> bytes:
+                if tracer is not None and span is not None:
+                    echo_trace_id(context, span.trace_id)
+                    tracer.finish(span, code=200)
+                return payload
+
             evaluator = worker.service.evaluator
             if messages is not None and evaluator is not None:
                 out = None
                 try:
-                    out = evaluator.is_allowed_batch_wire(messages)
+                    out = evaluator.is_allowed_batch_wire(
+                        messages, span=span
+                    )
                 except Exception:
                     out = None
                 if out is not None:
+                    if tracer is not None:
+                        t_stage = _time.perf_counter()
                     batch, decision, cacheable, status = out
                     responses: list = [None] * len(messages)
                     fallback_rows: list[int] = []
@@ -460,7 +520,15 @@ class GrpcServer:
                                 code=200, message="success"
                             ),
                         )
+                    if tracer is not None:
+                        now = _time.perf_counter()
+                        tracer.record(span, STAGE_DECODE, now - t_stage)
+                        t_stage = now
                     if fallback_reqs:
+                        if span is not None:
+                            for req in fallback_reqs:
+                                req._span = span
+                                req._sampling_done = True
                         # observe=False: this handler records batch_latency
                         # and decision counts for ALL rows below
                         for b, resp in zip(
@@ -480,15 +548,38 @@ class GrpcServer:
                             telemetry.decisions.inc(
                                 PB_TO_DECISION.get(resp.decision, "DENY")
                             )
-                    return serialize_batch_response(responses)
+                    if tracer is None:
+                        return serialize_batch_response(responses)
+                    t_stage = _time.perf_counter()
+                    payload = serialize_batch_response(responses)
+                    tracer.record(span, STAGE_SERIALIZE,
+                                  _time.perf_counter() - t_stage)
+                    return finish_rpc(payload)
+            if tracer is not None:
+                t_stage = _time.perf_counter()
             request = pb.BatchRequest.FromString(raw)
+            reqs = [request_from_pb(r) for r in request.requests]
+            if tracer is not None:
+                now = _time.perf_counter()
+                tracer.record(span, STAGE_TRANSPORT_PARSE, now - t_stage)
+                if span is not None:
+                    for req in reqs:
+                        req._span = span
+                        req._sampling_done = True
             responses = worker.service.is_allowed_batch(
-                [request_from_pb(r) for r in request.requests],
-                deadline=deadline,
+                reqs, deadline=deadline,
             )
-            return serialize_batch_response(
+            if tracer is None:
+                return serialize_batch_response(
+                    [response_to_pb(r) for r in responses]
+                )
+            t_stage = _time.perf_counter()
+            payload = serialize_batch_response(
                 [response_to_pb(r) for r in responses]
             )
+            tracer.record(span, STAGE_SERIALIZE,
+                          _time.perf_counter() - t_stage)
+            return finish_rpc(payload)
 
         def what_is_allowed(request, context):
             rq = worker.service.what_is_allowed(

@@ -32,11 +32,18 @@ policy maps PERMIT(0) to an explicit PERMIT effect.
 from __future__ import annotations
 
 import json
+import time
 
 import grpc
 
 from ..models.model import Attribute, Request, Target
 from .admission import deadline_from_context
+from .tracing import (
+    STAGE_SERIALIZE,
+    STAGE_TRANSPORT_PARSE,
+    echo_trace_id,
+    trace_id_from_metadata,
+)
 from .gen.rc import access_control_pb2 as rc_ac
 from .gen.rc import attribute_pb2 as rc_attr
 from .gen.rc import commandinterface_pb2 as rc_ci
@@ -397,17 +404,42 @@ def _read_filters_from_rc(msg) -> dict | None:
 def register_rc_services(server, worker) -> None:
     """Add the restorecommerce-wire generic handlers to a grpc server
     (called by GrpcServer alongside the acstpu services)."""
+    obs = getattr(worker, "obs", None)
 
     def is_allowed(request, context):
         # rc-wire deadline propagation: native gRPC deadlines and the
         # x-acs-timeout-ms metadata key both become the request budget
         # (srv/admission.deadline_from_context)
-        return response_to_rc(
-            worker.service.is_allowed(
-                request_from_rc(request),
-                deadline=deadline_from_context(context),
+        if obs is None or obs.tracer is None:
+            return response_to_rc(
+                worker.service.is_allowed(
+                    request_from_rc(request),
+                    deadline=deadline_from_context(context),
+                )
             )
+        # traced path: same span/trace-id contract as the acstpu-wire
+        # handler (srv/transport_grpc.py) — reference-wire clients get
+        # the identical observability surface
+        tracer = obs.tracer
+        t0 = time.perf_counter()
+        span = tracer.start_span(trace_id_from_metadata(context))
+        req = request_from_rc(request)
+        tracer.record(span, STAGE_TRANSPORT_PARSE,
+                      time.perf_counter() - t0)
+        req._sampling_done = True
+        if span is not None:
+            req._span = span
+        response = worker.service.is_allowed(
+            req, deadline=deadline_from_context(context)
         )
+        t_ser = time.perf_counter()
+        msg = response_to_rc(response)
+        tracer.record(span, STAGE_SERIALIZE, time.perf_counter() - t_ser)
+        if span is not None:
+            echo_trace_id(context, span.trace_id)
+            tracer.finish(span, decision=response.decision,
+                          code=response.operation_status.code)
+        return msg
 
     def what_is_allowed(request, context):
         return reverse_query_to_rc(

@@ -59,6 +59,7 @@ class Worker:
         self.offset_store: Optional[OffsetStore] = None
         self.logger = None
         self.mesh = None
+        self.obs = None  # srv/tracing.Observability (None = disabled)
 
     def start(
         self,
@@ -89,6 +90,18 @@ class Worker:
             self.logger = logger
             self._log_handlers = []
         self.telemetry = Telemetry()
+
+        # observability hub (srv/tracing.py, docs/OBSERVABILITY.md):
+        # stage-span tracing, sampled decision-audit log and the optional
+        # /metrics endpoint.  None unless the `observability` config block
+        # is enabled — absent/disabled, the serving path stays
+        # byte-identical to pre-observability behavior (differential:
+        # tests/test_tracing.py)
+        from .tracing import Observability
+
+        self.obs = Observability.from_config(
+            cfg, telemetry=self.telemetry, logger=self.logger
+        )
 
         # XLA dump hook (SURVEY section 5): best-effort — the flag is read
         # at backend initialization, so it only takes effect when set
@@ -283,6 +296,7 @@ class Worker:
             model_axis=model_axis,
             decision_cache=self.decision_cache,
             delta_enabled=bool(cfg.get("evaluator:delta_enabled", True)),
+            observability=self.obs,
         )
 
         # policy store with self-authorization hook; the hook consults the
@@ -301,7 +315,7 @@ class Worker:
         # service facade + command interface + micro-batcher
         self.service = AccessControlService(
             cfg, self.engine, self.evaluator, self.store, self.logger,
-            telemetry=self.telemetry,
+            telemetry=self.telemetry, observability=self.obs,
         )
         self.command_interface = CommandInterface(
             cfg,
@@ -311,6 +325,7 @@ class Worker:
             cache=self.subject_cache,
             decision_cache=self.decision_cache,
             admission=self.admission,
+            observability=self.obs,
             logger=self.logger,
         )
         self.batcher = MicroBatcher(
@@ -318,6 +333,7 @@ class Worker:
             window_ms=cfg.get("evaluator:micro_batch_window_ms", 2),
             max_batch=cfg.get("evaluator:micro_batch_max", 4096),
             admission=self.admission,
+            observability=self.obs,
         )
         self.batcher.start()
         self.service.batcher = self.batcher
@@ -386,6 +402,9 @@ class Worker:
             backend = getattr(self, attr, None)
             if backend is not None and hasattr(backend, "close"):
                 backend.close()
+        if getattr(self, "obs", None) is not None:
+            # stop the /metrics endpoint and close the audit sink
+            self.obs.close()
         if hasattr(self.identity_client, "close"):
             self.identity_client.close()
 

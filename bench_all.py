@@ -69,7 +69,7 @@ _CONFIG_KNOBS = (
     "TOKENMIX_TOKENS", "BENCH_PLATFORM", "OVERLOAD_DEADLINE_MS",
     "OVERLOAD_DURATION_S", "OVERLOAD_X", "OVERLOAD_QUEUE",
     "OVERLOAD_GENERATORS", "OVERLOAD_WARMUP_S", "OVERLOAD_CAL_THREADS",
-    "OVERLOAD_RULES",
+    "OVERLOAD_RULES", "PROFILE_RULES", "PROFILE_BATCH", "PROFILE_CALLS",
 )
 
 
@@ -914,6 +914,43 @@ def _serving_batch_msg(n, rng, wide=False):
     return batch
 
 
+# serve-family benches run with stage tracing enabled (histograms only,
+# span sampling off — measured overhead <5% even on the single-request
+# path) so every serve row carries its own wire-to-kernel attribution
+_SERVE_OBSERVABILITY = {
+    "observability": {
+        "enabled": True,
+        "tracing": {"enabled": True, "sample_rate": 0.0},
+    },
+}
+
+
+def _stage_breakdown(telemetry):
+    """Per-stage breakdown dict stamped into serve-family rows: count /
+    total_s / interpolated p50/p99 ms per stage (srv/tracing.py
+    taxonomy).  Benches call ``telemetry.stages.clear()`` after warmup
+    so totals AND percentiles cover the timed window only (the warmup
+    XLA compile would otherwise dominate the device p99)."""
+    if telemetry is None:
+        return None
+    stages = telemetry.snapshot().get("stages")
+    if not stages:
+        return None
+    out = {}
+    for stage, snap in sorted(stages.items()):
+        if not snap["count"]:
+            continue
+        out[stage] = {
+            "count": snap["count"],
+            "total_s": round(snap["sum_s"], 6),
+            "p50_ms": round(snap["p50_s"] * 1e3, 4)
+            if snap["p50_s"] is not None else None,
+            "p99_ms": round(snap["p99_s"] * 1e3, 4)
+            if snap["p99_s"] is not None else None,
+        }
+    return out or None
+
+
 def bench_serving_e2e():
     """Wire-to-wire throughput: serialized BatchRequest -> gRPC ->
     native C++ wire encoder -> kernel -> response bytes, over loopback
@@ -924,13 +961,16 @@ def bench_serving_e2e():
     n_rules = int(os.environ.get("SERVE_RULES", 20_000))
     per_call = int(os.environ.get("SERVE_BATCH", 8192))
     calls = int(os.environ.get("SERVE_CALLS", 8))
-    worker, server, client = _serving_worker(n_rules)
+    worker, server, client = _serving_worker(
+        n_rules, cfg_extra=dict(_SERVE_OBSERVABILITY)
+    )
     try:
         native = bool(worker.evaluator.native_active)
         rng = np.random.default_rng(11)
         batch = _serving_batch_msg(per_call, rng, wide=True)
         resp = client.is_allowed_batch(batch)  # warmup (compiles)
         assert len(resp.responses) == per_call
+        worker.telemetry.stages.clear()  # attribution without warmup
         t0 = time.perf_counter()
         for _ in range(calls):
             client.is_allowed_batch(batch)
@@ -947,7 +987,8 @@ def bench_serving_e2e():
              "native_wire_rows": paths.get("native-wire", 0),
              "eligible_pct": round(
                  100.0 * paths.get("native-wire", 0)
-                 / max(1, per_call * (calls + 1)), 1)},
+                 / max(1, per_call * (calls + 1)), 1),
+             "stage_breakdown": _stage_breakdown(worker.telemetry)},
         )
     finally:
         client.close()
@@ -960,7 +1001,9 @@ def bench_serving_latency():
     (VERDICT r4 item 9: the window default predates the measured
     dispatch floor; single outstanding requests take the oracle path by
     design, so this measures the serving shell, not the device)."""
-    worker, server, client = _serving_worker(0)
+    worker, server, client = _serving_worker(
+        0, cfg_extra=dict(_SERVE_OBSERVABILITY)
+    )
     try:
         import numpy as np
 
@@ -974,6 +1017,7 @@ def bench_serving_latency():
         msg.CopyFrom(single)
         for _ in range(50):
             client.is_allowed(msg)  # warmup
+        worker.telemetry.stages.clear()  # attribution without warmup
         for _ in range(500):
             t0 = time.perf_counter()
             client.is_allowed(msg)
@@ -988,8 +1032,107 @@ def bench_serving_latency():
             "requests/s/stream",
             {"p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
              "window_ms": worker.batcher.window_s * 1e3,
-             "n": len(lat)},
+             "n": len(lat),
+             "stage_breakdown": _stage_breakdown(worker.telemetry)},
         )
+    finally:
+        client.close()
+        server.stop()
+        worker.stop()
+
+
+def bench_wire_profile():
+    """Wire-to-kernel host-time attribution (ROADMAP "close the
+    wire-to-kernel gap": a profile showing where the remaining host time
+    goes).  Runs the serve config with stage tracing at 100% span
+    sampling and raw-byte client calls so BOTH sides of the wire are
+    attributed: client serialize / parse timed here, every server stage
+    (transport parse -> native encode -> device -> decode -> serialize)
+    from the stage histograms, and the gRPC loopback residual computed
+    as wall minus everything attributed.  The headline value is the
+    fraction of measured wire-to-wire wall clock the instrumented stages
+    account for (acceptance bar: >= 90%)."""
+    import numpy as np
+
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    n_rules = int(os.environ.get(
+        "PROFILE_RULES", os.environ.get("SERVE_RULES", 20_000)))
+    per_call = int(os.environ.get(
+        "PROFILE_BATCH", os.environ.get("SERVE_BATCH", 8192)))
+    calls = int(os.environ.get("PROFILE_CALLS", 8))
+    worker, server, client = _serving_worker(n_rules, cfg_extra={
+        "observability": {
+            "enabled": True,
+            "tracing": {"enabled": True, "sample_rate": 1.0},
+        },
+    })
+    try:
+        native = bool(worker.evaluator.native_active)
+        rng = np.random.default_rng(11)
+        batch = _serving_batch_msg(per_call, rng, wide=True)
+        # raw-byte call: the bench times client-side serialize/parse as
+        # explicit stages instead of hiding them in the grpc stub
+        call = client.channel.unary_unary(
+            "/acstpu.AccessControlService/IsAllowedBatch",
+            request_serializer=lambda raw: raw,
+            response_deserializer=lambda raw: raw,
+        )
+        raw = batch.SerializeToString()
+        resp = pb.BatchResponse.FromString(call(raw))  # warmup (compiles)
+        assert len(resp.responses) == per_call
+        worker.telemetry.stages.clear()  # attribution without warmup
+
+        client_ser = client_parse = 0.0
+        t_begin = time.perf_counter()
+        for _ in range(calls):
+            t0 = time.perf_counter()
+            raw = batch.SerializeToString()
+            client_ser += time.perf_counter() - t0
+            raw_resp = call(raw)
+            t0 = time.perf_counter()
+            pb.BatchResponse.FromString(raw_resp)
+            client_parse += time.perf_counter() - t0
+        wall = time.perf_counter() - t_begin
+
+        breakdown = _stage_breakdown(worker.telemetry) or {}
+        breakdown["client.serialize"] = {
+            "count": calls, "total_s": round(client_ser, 6),
+            "p50_ms": round(client_ser / calls * 1e3, 4), "p99_ms": None,
+        }
+        breakdown["client.parse"] = {
+            "count": calls, "total_s": round(client_parse, 6),
+            "p50_ms": round(client_parse / calls * 1e3, 4), "p99_ms": None,
+        }
+        attributed = sum(s["total_s"] for s in breakdown.values())
+        for stage in breakdown.values():
+            stage["pct_of_wall"] = round(
+                100.0 * stage["total_s"] / wall, 2)
+        residual = wall - attributed
+        coverage_pct = 100.0 * attributed / wall
+        row = _result(
+            f"wire-to-kernel host-time attribution (serve config, "
+            f"{n_rules}-rule tree)",
+            coverage_pct,
+            "% of wall clock attributed",
+            {
+                "batch": per_call, "calls": calls,
+                "native_active": native,
+                "wall_s": round(wall, 4),
+                "wire_to_wire_dec_per_s": round(per_call * calls / wall, 1),
+                "stages": breakdown,
+                "grpc_residual_s": round(residual, 4),
+                "grpc_residual_pct": round(100.0 * residual / wall, 2),
+                "bar": ">=90% of measured wire-to-wire wall clock "
+                       "attributed to instrumented stages",
+            },
+        )
+        # sampled span trees: every call produced one complete RPC span
+        traces = worker.obs.tracer.traces()
+        assert len(traces) >= calls, (
+            "100% sampling must retain one span per RPC"
+        )
+        return row
     finally:
         client.close()
         server.stop()
@@ -1670,8 +1813,9 @@ ACCEL_OK = True  # cleared by main() when the backend probe fails
 def main():
     which = sys.argv[1:] or ["scalar", "batched", "wia", "wia-large", "hr",
                              "hr-deep", "stress", "stress-hr", "serve",
-                             "serve-latency", "token-mix", "adapter-mixed",
-                             "adapter-mixed-warm", "crud-churn", "overload"]
+                             "serve-latency", "wire-profile", "token-mix",
+                             "adapter-mixed", "adapter-mixed-warm",
+                             "crud-churn", "overload"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -1748,6 +1892,7 @@ def main():
         "stress-hr": bench_stress_hr,
         "serve": bench_serving_e2e,
         "serve-latency": bench_serving_latency,
+        "wire-profile": bench_wire_profile,
         "token-mix": bench_token_mix,
         "adapter-mixed": bench_adapter_mixed,
         "adapter-mixed-warm": bench_adapter_mixed_warm,

@@ -3,6 +3,8 @@ logging, and the metrics command (SURVEY.md §5 aux subsystems)."""
 
 import logging
 
+import pytest
+
 from access_control_srv_tpu.srv import Worker
 from access_control_srv_tpu.srv.telemetry import (
     Histogram,
@@ -58,6 +60,43 @@ def test_histogram_buckets_and_mean():
     assert snap["buckets"]["inf"] == 4
     assert snap["buckets"]["5e-05"] == 1
     assert abs(snap["mean_s"] - (1e-5 + 1e-3 + 0.1 + 5.0) / 4) < 1e-6
+
+
+def test_histogram_percentile_estimates():
+    """snapshot() reports interpolated p50/p95/p99 so consumers
+    (health_check, bench rows) read percentiles, not bucket arrays."""
+    h = Histogram()
+    for _ in range(98):
+        h.observe(0.010)       # bucket (0.0128]: (0.0032, 0.0128]
+    for _ in range(2):
+        h.observe(100.0)       # inf bucket
+    snap = h.snapshot()
+    # p50 interpolates inside the 3.2ms..12.8ms bucket
+    assert 0.0032 <= snap["p50_s"] <= 0.0128
+    assert 0.0032 <= snap["p95_s"] <= 0.0128
+    # p99 lands in the inf bucket -> clamped to the last finite bound
+    assert snap["p99_s"] == pytest.approx(52.4)
+    # monotone
+    assert snap["p50_s"] <= snap["p95_s"] <= snap["p99_s"]
+
+
+def test_empty_histogram_percentiles_are_none():
+    snap = Histogram().snapshot()
+    assert snap["p50_s"] is None
+    assert snap["p95_s"] is None
+    assert snap["p99_s"] is None
+
+
+def test_value_histogram_percentiles():
+    from access_control_srv_tpu.srv.telemetry import ValueHistogram
+
+    h = ValueHistogram()
+    for depth in (1, 2, 3, 4, 100, 200, 300, 400, 500, 5000):
+        h.observe(depth)
+    snap = h.snapshot()
+    assert snap["p50"] is not None
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    assert snap["max"] == 5000
 
 
 def test_service_records_latency_and_decisions():
@@ -217,6 +256,109 @@ def test_json_sink_ships_masked_structured_lines(tmp_path):
     assert lines[1]["level"] == "WARNING"
     assert lines[1]["password"] == "***"
     assert all("@timestamp" in ln for ln in lines)
+
+
+def test_health_check_reports_latency_percentiles():
+    """health_check surfaces interpolated latency percentiles, not raw
+    bucket arrays (observability satellite)."""
+    w = Worker().start(seed_cfg())
+    try:
+        for _ in range(5):
+            w.service.is_allowed(admin_request())
+        health = w.command_interface.command("health_check")
+        latency = health["latency"]["is_allowed"]
+        assert latency["count"] == 5
+        assert latency["p50_ms"] is not None
+        assert latency["p50_ms"] <= latency["p95_ms"] <= latency["p99_ms"]
+    finally:
+        w.stop()
+
+
+def test_prometheus_exposition_format():
+    """The registry renders valid Prometheus text exposition: HELP/TYPE
+    headers, labeled counter series, cumulative histogram buckets with
+    +Inf, _sum and _count."""
+    t = Telemetry()
+    t.decisions.inc("PERMIT", 3)
+    t.decisions.inc("DENY")
+    t.is_allowed_latency.observe(0.002)
+    t.is_allowed_latency.observe(0.004)
+    body = t.prometheus()
+    assert "# TYPE acs_decisions_total counter" in body
+    assert 'acs_decisions_total{decision="PERMIT"} 3' in body
+    assert 'acs_decisions_total{decision="DENY"} 1' in body
+    assert "# TYPE acs_is_allowed_latency_seconds histogram" in body
+    assert 'acs_is_allowed_latency_seconds_bucket{le="+Inf"} 2' in body
+    assert "acs_is_allowed_latency_seconds_count 2" in body
+    # cumulative buckets are monotone non-decreasing
+    counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in body.splitlines()
+        if line.startswith("acs_is_allowed_latency_seconds_bucket")
+    ]
+    assert counts == sorted(counts)
+    # empty counters render nothing (no empty families)
+    assert "acs_admission_events_total" not in body
+
+
+def test_prometheus_label_escaping():
+    t = Telemetry()
+    t.paths.inc('weird"key\\with\nstuff')
+    body = t.prometheus()
+    assert 'path="weird\\"key\\\\with\\nstuff"' in body
+
+
+def test_snapshot_deep_copy_under_mutation_stress():
+    """Concurrent metrics/health_check readers must never observe a dict
+    mutating mid-serialization: snapshot() assembles under the lock and
+    returns a deep copy, so json.dumps over it cannot race a writer."""
+    import json as _json
+    import threading
+
+    t = Telemetry()
+    stop = threading.Event()
+    errors = []
+
+    def writer(n):
+        i = 0
+        while not stop.is_set():
+            t.decisions.inc(f"D{i % 37}")
+            t.paths.inc(f"path-{i % 11}", 2)
+            t.admission.inc(f"k{i % 7}")
+            t.is_allowed_latency.observe(0.001 * (i % 5))
+            t.stage_histogram(f"stage-{i % 3}").observe(0.0001)
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            snap = t.snapshot()
+            try:
+                _json.dumps(snap)
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+                return
+            # mutating the returned snapshot must not touch live state
+            snap["decisions"]["INJECTED"] = 1
+
+    threads = [threading.Thread(target=writer, args=(n,)) for n in range(2)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for thread in threads:
+        thread.start()
+    import time as _time
+
+    _time.sleep(0.5)
+    stop.set()
+    for thread in threads:
+        thread.join()
+    assert not errors, errors
+    assert "INJECTED" not in t.decisions.snapshot()
+
+
+def test_sampled_logger_importable_from_telemetry():
+    from access_control_srv_tpu.srv.telemetry import SampledLogger
+
+    slog = SampledLogger(None, max_per_interval=2)
+    slog.warning("k", "m")  # None logger: no-op by contract
 
 
 def test_worker_config_wires_json_sink(tmp_path):

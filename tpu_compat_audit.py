@@ -597,6 +597,58 @@ def main() -> int:
                  "deadline math are host-side by construction"),
     })
 
+    # 7. stage-span tracing must be host-only: the tracing module may not
+    # import jax, and a batch evaluated with tracing ENABLED at 100%
+    # sampling (spans attached to every request, stage histograms fed,
+    # batch stages fanned out) must lower to the BYTE-identical device
+    # program as the untraced path — tracing watches the pipeline with
+    # perf_counter reads, it never touches what the device runs
+    import access_control_srv_tpu.srv.tracing as trc_mod
+    from access_control_srv_tpu.srv.tracing import Observability, StageTracer
+
+    trc_src = open(trc_mod.__file__).read()
+    trc_imports_jax = re.search(r"^\s*(import|from)\s+jax\b", trc_src, re.M)
+    tracer = StageTracer(sample_rate=1.0)
+    hybrid_d.obs = Observability(tracer=tracer)
+    traced_reqs = [_d_request(k) for k in range(12)]
+    spans = []
+    for req in traced_reqs:
+        span = tracer.start_span()
+        req._span = span
+        req._sampling_done = True
+        spans.append(span)
+    traced_served = hybrid_d.is_allowed_batch(traced_reqs)
+    for span in spans:
+        tracer.finish(span)
+    hybrid_d.obs = None
+    batch_traced = encode_requests(traced_reqs, hybrid_d._compiled)
+    hlo_traced = _lower_dyn(hybrid_d._compiled, reqs=traced_reqs)
+    span_trees = tracer.traces()
+    stages_seen = set()
+    for trace in span_trees:
+        stages_seen |= {s["stage"] for s in trace["stages"]}
+    tracing_ok = (
+        not trc_imports_jax
+        and len(traced_served) == 12
+        and bool(batch_traced.eligible.all())
+        and hlo_traced == hlo_patched       # byte-identical device program
+        and len(span_trees) == 12
+        and {"encode", "device", "decode"} <= stages_seen
+    )
+    results.append({
+        "kernel": "tracing-zero-device-ops",
+        "ok": bool(tracing_ok),
+        "imports_jax": bool(trc_imports_jax),
+        "hlo_identical": hlo_traced == hlo_patched,
+        "span_trees": len(span_trees),
+        "stages_observed": sorted(stages_seen),
+        "note": ("batch evaluated with stage tracing at 100% sampling "
+                 "(spans on every row, encode/device/decode fanned out) "
+                 "lowers to the BYTE-identical device program as the "
+                 "untraced path; srv/tracing.py never imports jax — "
+                 "attribution is host-side by construction"),
+    })
+
     verdict = {
         "backend": backend,
         "device": str(jax.devices()[0]),
