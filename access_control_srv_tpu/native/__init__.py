@@ -123,6 +123,20 @@ def _load():
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_int32),  # caps (13 ints) or None
         ]
+        lib.acs_own_max_runs.restype = ctypes.c_int32
+        lib.acs_own_max_runs.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.acs_pack_owner_bits.restype = None
+        # raw buffer pointers + dims; see host_encoder.cpp for the order
+        lib.acs_pack_owner_bits.argtypes = (
+            [ctypes.c_void_p] * 14
+            + [ctypes.c_int32] * 6
+            + [ctypes.c_void_p, ctypes.c_void_p]
+            + [ctypes.c_int32] * 2
+            + [ctypes.c_void_p, ctypes.c_void_p]
+        )
         _lib = lib
         return _lib
 
@@ -196,6 +210,63 @@ class NativeBatchEncoder:
         # the C++ encoder mutates shared state (interner, caches) and
         # ctypes releases the GIL -- one batch at a time per encoder
         self._call_lock = threading.Lock()
+        # stage-B owner-bit vocab: with HR-bearing targets the packed
+        # bitplanes are computed NATIVELY (acs_pack_owner_bits,
+        # bit-identical to ops/encode.pack_owner_bitplanes — fuzz-checked
+        # by tests/test_native_encoder.py), closing the last per-batch
+        # Python/numpy compute on the wire encode stage
+        if _pyenc.owner_bits_needed(compiled):
+            self._hrv_role = np.ascontiguousarray(
+                np.asarray(compiled.arrays["hrv_role"]), dtype=np.int32
+            )
+            self._hrv_scope = np.ascontiguousarray(
+                np.asarray(compiled.arrays["hrv_scope"]), dtype=np.int32
+            )
+        else:
+            self._hrv_role = self._hrv_scope = None
+        # pooled staging (ops/staging.py): with ``reuse=True`` the row
+        # arrays, masks, regex matrices and owner-bit buffers all recycle
+        # through arenas keyed by their (shape, caps) bucket — a warm
+        # pipeline allocates NOTHING per batch on this stage.  The batch
+        # carries a release callable; callers fire it after materialize.
+        from ..ops.staging import default_pool
+
+        self._pool = default_pool()
+        self._arena: dict[tuple, list[dict]] = {}
+        self._arena_lock = threading.Lock()
+        self.arena_hits = 0
+        self.arena_misses = 0
+
+    # ------------------------------------------------------- staging arena
+
+    def _acquire_rows(self, B: int, caps) -> tuple[tuple, dict]:
+        caps_key = tuple(sorted((caps or _pyenc._CAPS_FLOOR).items()))
+        key = (B, caps_key)
+        with self._arena_lock:
+            free = self._arena.get(key)
+            if free:
+                self.arena_hits += 1
+                rows = free.pop()
+            else:
+                self.arena_misses += 1
+                rows = None
+        if rows is not None:
+            return key, _pyenc.reset_row_arrays(rows)
+        return key, _pyenc.alloc_row_arrays(B, caps)
+
+    def _release_rows(self, key: tuple, rows: dict) -> None:
+        with self._arena_lock:
+            free = self._arena.setdefault(key, [])
+            if len(free) < 8:
+                free.append(rows)
+
+    def arena_stats(self) -> dict:
+        with self._arena_lock:
+            return {
+                "hits": self.arena_hits,
+                "misses": self.arena_misses,
+                "free_sets": sum(len(v) for v in self._arena.values()),
+            }
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -208,25 +279,101 @@ class NativeBatchEncoder:
         self.lib.acs_enc_string(self._handle, idx, buf, n)
         return buf.raw[:n].decode()
 
+    def owner_bits_native(self, a: dict, B: int, take=None) -> dict:
+        """Packed stage-B owner bitplanes via the C++ packer — the native
+        replacement for ops/encode.pack_owner_bitplanes over the same raw
+        row arrays (bit-identical; fuzz-checked).  ``take(shape, dtype)``
+        supplies buffers (the staging arena in pooled mode); np.empty
+        otherwise."""
+        from ..ops.encode import owner_bit_layout
+        from ..ops.interner import ABSENT as _ABS
+
+        if take is None:
+            take = np.empty
+        if self._hrv_role is None:
+            out_runs = take((B, 1), np.int32)
+            out_bits = take((B, 1), np.int32)
+            out_runs.fill(_ABS)
+            out_bits.fill(0)
+            return {"r_own_runs": out_runs, "r_own_bits": out_bits}
+        NI = a["r_inst_run"].shape[1]
+        NOWN = a["r_inst_owner_ent"].shape[2]
+        NOP = a["r_op_vals"].shape[1]
+        NRA = a["r_ra3"].shape[1]
+        NHR = a["r_hr"].shape[1]
+        RV = self._hrv_role.shape[0]
+        max_runs = self.lib.acs_own_max_runs(
+            a["r_inst_run"].ctypes.data, a["r_inst_valid"].ctypes.data,
+            B, NI,
+        )
+        nru = _pyenc._pow2_at_least(int(max_runs) if B else 1, 1)
+        _, _, _, nwords = owner_bit_layout(RV, nru, NOP)
+        out_runs = take((B, nru), np.int32)
+        out_bits = take((B, nwords), np.int32)
+        self.lib.acs_pack_owner_bits(
+            a["r_inst_run"].ctypes.data, a["r_inst_valid"].ctypes.data,
+            a["r_inst_present"].ctypes.data,
+            a["r_inst_has_owners"].ctypes.data,
+            a["r_inst_owner_ent"].ctypes.data,
+            a["r_inst_owner_inst"].ctypes.data,
+            a["r_op_vals"].ctypes.data, a["r_op_present"].ctypes.data,
+            a["r_op_has_owners"].ctypes.data,
+            a["r_op_owner_ent"].ctypes.data,
+            a["r_op_owner_inst"].ctypes.data,
+            a["r_ra3"].ctypes.data, a["r_ra2"].ctypes.data,
+            a["r_hr"].ctypes.data,
+            B, NI, NOWN, NOP, NRA, NHR,
+            self._hrv_role.ctypes.data, self._hrv_scope.ctypes.data,
+            RV, nru,
+            out_runs.ctypes.data, out_bits.ctypes.data,
+        )
+        return {"r_own_runs": out_runs, "r_own_bits": out_bits}
+
     def encode_wire(self, messages: list[bytes],
-                    caps: dict[str, int] | None = None) -> RequestBatch:
+                    caps: dict[str, int] | None = None,
+                    reuse: bool = False) -> RequestBatch:
         """Encode serialized acstpu.Request messages.
 
         ``caps`` overrides the per-request padding shapes (the floor
         defaults otherwise).  Rows that were ineligible ONLY because a
         cap overflowed come back flagged in ``batch.overcap`` — the
         serving path re-encodes exactly those rows at the ceiling shapes
-        (ops/encode._CAPS_CEIL) so deep-HR wire traffic stays native."""
+        (ops/encode._CAPS_CEIL) so deep-HR wire traffic stays native.
+
+        ``reuse=True`` draws every buffer (row arrays, masks, regex
+        matrices, owner bits) from the staging arenas and attaches a
+        ``batch.staging`` release callable — the depth-N pipeline fires
+        it after materialize, after which the buffers recycle.  The
+        default allocates fresh (callers that hold batches indefinitely
+        must not pin arena slots)."""
+        from ..ops.kernel import pow2_bucket
+
         B = len(messages)
         blob = b"".join(messages)
-        offs = np.zeros(B + 1, np.int64)
+        pool = self._pool if reuse else None
+        leases: list[np.ndarray] = []
+
+        def take(shape, dtype):
+            if pool is None:
+                return np.empty(shape, dtype)
+            buf = pool.acquire(shape, dtype)
+            leases.append(buf)
+            return buf
+
+        offs = take((B + 1,), np.int64)
+        offs[0] = 0
         np.cumsum([len(m) for m in messages], out=offs[1:])
 
-        a = _pyenc.alloc_row_arrays(B, caps)
-        eligible = np.ones((B,), np.uint8)
-        overcap = np.zeros((B,), np.uint8)
+        if reuse:
+            rows_key, a = self._acquire_rows(B, caps)
+        else:
+            rows_key, a = None, _pyenc.alloc_row_arrays(B, caps)
+        eligible = take((B,), np.uint8)
+        eligible.fill(1)
+        overcap = take((B,), np.uint8)
+        overcap.fill(0)
         nr = (caps or _pyenc._CAPS_FLOOR)["NR"]
-        batch_entities = np.zeros((max(B, 1) * nr,), np.int32)
+        batch_entities = take((max(B, 1) * nr,), np.int32)
         caps_arg = None
         if caps is not None:
             caps_arr = np.array(
@@ -253,16 +400,26 @@ class NativeBatchEncoder:
                 caps_arg,
             )
             if n_entities < 0:
+                if reuse:
+                    self._release_rows(rows_key, a)
+                    pool.release_all(leases)
                 raise ValueError("malformed wire batch")
 
             # regex matrices over distinct batch entities (host regex work
             # is per distinct entity value, same as the Python encoder);
             # the _string readbacks stay under the lock -- they touch the
-            # same C++ interner a concurrent batch would be mutating
+            # same C++ interner a concurrent batch would be mutating.
+            # Pooled mode allocates at the pow2 entity bucket the kernels
+            # pad to anyway (zero-filled tail columns are what pad_cols
+            # would add), so recycled matrices skip that copy too.
             W = max(len(self.compiled.entity_vocab), 1)
             E = max(int(n_entities), 1)
-            rgx_set = np.zeros((W, E), bool)
-            pfx_neq = np.zeros((W, E), bool)
+            if reuse:
+                E = pow2_bucket(E)
+            rgx_set = take((W, E), bool)
+            rgx_set.fill(0)
+            pfx_neq = take((W, E), bool)
+            pfx_neq.fill(0)
             for e in range(int(n_entities)):
                 value = self._string(int(batch_entities[e]))
                 set_col, neq_col = self._rgx.lookup(value)
@@ -270,23 +427,31 @@ class NativeBatchEncoder:
                     rgx_set[:, e] = set_col
                     pfx_neq[:, e] = neq_col
 
-        # stage-B owner bitplanes: the C++ core emits the raw wire-shaped
-        # arrays; the packed owner-bit columns are deferred to the shared
-        # Python packer (a pure vectorized-numpy function of those arrays),
-        # so the native and Python encode paths are bit-identical by
-        # construction
-        a.update(_pyenc.pack_owner_bitplanes(a, self.compiled))
+        # stage-B owner bitplanes, packed natively (bit-identical to the
+        # Python packer ops/encode.pack_owner_bitplanes — structural for
+        # trees without HR targets, fuzz-checked with them)
+        arrays = dict(a)  # the arena keeps its canonical row-array dict
+        arrays.update(self.owner_bits_native(
+            a, B, take=take if reuse else None
+        ))
+
+        release = None
+        if reuse:
+            def release(_key=rows_key, _rows=a, _leases=leases):
+                self._release_rows(_key, _rows)
+                pool.release_all(_leases)
 
         C = len(self.compiled.conditions)  # always 0 (ctor guard)
         return RequestBatch(
             B=B,
-            arrays=a,
+            arrays=arrays,
             rgx_set=rgx_set,
             pfx_neq=pfx_neq,
             cond_true=np.zeros((C, B), bool),
             cond_abort=np.zeros((C, B), bool),
             cond_code=np.full((C, B), 200, np.int32),
-            eligible=eligible.astype(bool),
+            eligible=eligible.view(np.bool_),
             requests=[],
-            overcap=overcap.astype(bool),
+            overcap=overcap.view(np.bool_),
+            staging=release,
         )

@@ -1115,4 +1115,162 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
   return n_batch_entities;
 }
 
+// --------------------------------------------------- owner-bit packing
+// Native transcription of ops/encode.pack_owner_bitplanes (which PR 2
+// deferred to the Python packer): per (row, role-scope-vocab entry) the
+// stage-B owner verdicts pack as 2*(nru+NOP) fail bits, laid out exactly
+// per ops/encode.owner_bit_layout.  Bit-identity with the Python packer
+// is enforced by tests/test_native_encoder.py's fuzz comparison; with
+// this, the native encode stage runs zero per-row (and zero per-batch)
+// Python.
+
+// max over rows of the count of DISTINCT valid instance-bearing runs
+// (the Python packer's `counts.max()`; the caller pow2-buckets it to nru)
+int32_t acs_own_max_runs(const int32_t* inst_run, const uint8_t* inst_valid,
+                         int32_t B, int32_t NI) {
+  int32_t max_runs = 0;
+  for (int32_t b = 0; b < B; ++b) {
+    int32_t distinct = 0;
+    // NI is tiny (<= 32): quadratic dedup beats any allocation
+    for (int32_t i = 0; i < NI; ++i) {
+      if (!inst_valid[b * NI + i]) continue;
+      int32_t run = inst_run[b * NI + i];
+      if (run < 0) continue;
+      bool seen = false;
+      for (int32_t j = 0; j < i; ++j)
+        seen |= inst_valid[b * NI + j] && inst_run[b * NI + j] == run;
+      if (!seen) ++distinct;
+    }
+    if (distinct > max_runs) max_runs = distinct;
+  }
+  return max_runs;
+}
+
+// own_runs_out: [B, nru] (filled ABSENT-padded, sorted ascending);
+// bits_out: [B, nwords] uint32 (fully overwritten) where nwords follows
+// owner_bit_layout(RV, nru, NOP).  Raw arrays are the acs_enc_batch
+// outputs (or any buffers the Python packer would accept).
+void acs_pack_owner_bits(
+    const int32_t* inst_run, const uint8_t* inst_valid,
+    const uint8_t* inst_present, const uint8_t* inst_has_owners,
+    const int32_t* inst_owner_ent, const int32_t* inst_owner_inst,
+    const int32_t* op_vals, const uint8_t* op_present,
+    const uint8_t* op_has_owners,
+    const int32_t* op_owner_ent, const int32_t* op_owner_inst,
+    const int32_t* ra3, const int32_t* ra2, const int32_t* hr,
+    int32_t B, int32_t NI, int32_t NOWN, int32_t NOP, int32_t NRA,
+    int32_t NHR, const int32_t* hrv_role, const int32_t* hrv_scope,
+    int32_t RV, int32_t nru, int32_t* own_runs_out, uint32_t* bits_out) {
+  const int ebits = 2 * (nru + NOP);
+  int epw = 0, wpe = 1, nwords;
+  if (ebits <= 32) {
+    epw = 32 / ebits;
+    nwords = (RV + epw - 1) / epw;
+  } else {
+    epw = 0;
+    wpe = (ebits + 31) / 32;
+    nwords = RV * wpe;
+  }
+  std::vector<int32_t> runs;            // distinct valid runs, ascending
+  std::vector<uint8_t> bits(ebits);     // per-entry fail bits, k-indexed
+  for (int32_t b = 0; b < B; ++b) {
+    const int32_t* b_inst_run = inst_run + b * NI;
+    const uint8_t* b_inst_valid = inst_valid + b * NI;
+    const int32_t* b_ra3 = ra3 + b * NRA * 3;
+    const int32_t* b_ra2 = ra2 + b * NRA * 2;
+    const int32_t* b_hr = hr + b * NHR * 2;
+    uint32_t* b_words = bits_out + (int64_t)b * nwords;
+    for (int w = 0; w < nwords; ++w) b_words[w] = 0;
+    int32_t* b_runs = own_runs_out + (int64_t)b * nru;
+    for (int g = 0; g < nru; ++g) b_runs[g] = ABSENT;
+
+    runs.clear();
+    for (int32_t i = 0; i < NI; ++i) {
+      if (!b_inst_valid[i]) continue;
+      int32_t run = b_inst_run[i];
+      if (run < 0) continue;
+      auto it = runs.begin();
+      while (it != runs.end() && *it < run) ++it;
+      if (it == runs.end() || *it != run) runs.insert(it, run);
+    }
+    for (size_t g = 0; g < runs.size() && (int)g < nru; ++g)
+      b_runs[g] = runs[g];
+
+    for (int32_t e = 0; e < RV; ++e) {
+      const int32_t role_e = hrv_role[e];
+      const int32_t scope_e = hrv_scope[e];
+      // ra2_ok: the (role, scoping entity) pair exists among the valid
+      // role-association pairs (mirrors _owner_verdicts' ra2 branch)
+      bool ra2_ok = false;
+      for (int32_t j = 0; j < NRA; ++j)
+        ra2_ok |= b_ra2[j * 2 + 1] >= 0 && b_ra2[j * 2] == role_e &&
+                  b_ra2[j * 2 + 1] == scope_e;
+
+      // dir/hier verdict for ONE owner (entity, instance) pair
+      auto pair_ok = [&](int32_t qe, int32_t qi, bool* dir, bool* hier) {
+        *dir = false;
+        *hier = false;
+        if (qe != scope_e || qe < 0) return;  // ent_m gate
+        for (int32_t j = 0; j < NRA && !*dir; ++j)
+          *dir = b_ra3[j * 3 + 1] >= 0 && b_ra3[j * 3] == role_e &&
+                 b_ra3[j * 3 + 1] == scope_e && b_ra3[j * 3 + 2] == qi;
+        if (ra2_ok)
+          for (int32_t j = 0; j < NHR && !*hier; ++j)
+            *hier = b_hr[j * 2 + 1] >= 0 && b_hr[j * 2] == role_e &&
+                    b_hr[j * 2 + 1] == qi;
+      };
+
+      for (int k = 0; k < ebits; ++k) bits[k] = 0;
+      for (int32_t i = 0; i < NI; ++i) {
+        // valid_i in the Python packer is r_inst_valid & (inst_run >= 0)
+        if (!b_inst_valid[i] || b_inst_run[i] < 0) continue;
+        bool miss = !(inst_present[b * NI + i] && inst_has_owners[b * NI + i]);
+        bool any_dir = false, any_hier = false;
+        for (int32_t o = 0; o < NOWN; ++o) {
+          bool dir, hier;
+          pair_ok(inst_owner_ent[(b * NI + i) * NOWN + o],
+                  inst_owner_inst[(b * NI + i) * NOWN + o], &dir, &hier);
+          any_dir |= dir;
+          any_hier |= hier;
+        }
+        bool bad_a = miss || !(any_dir || any_hier);
+        bool bad_b = miss || !any_dir;
+        if (!bad_a && !bad_b) continue;
+        // fold into the run group this instance belongs to
+        int32_t run = b_inst_run[i];
+        for (int g = 0; g < nru; ++g) {
+          if (b_runs[g] != run) continue;
+          bits[g] |= bad_a ? 1 : 0;
+          bits[nru + g] |= bad_b ? 1 : 0;
+        }
+      }
+      for (int32_t j = 0; j < NOP; ++j) {
+        if (op_vals[b * NOP + j] < 0) continue;  // op_valid gate
+        bool miss = !(op_present[b * NOP + j] && op_has_owners[b * NOP + j]);
+        bool any_dir = false, any_hier = false;
+        for (int32_t o = 0; o < NOWN; ++o) {
+          bool dir, hier;
+          pair_ok(op_owner_ent[(b * NOP + j) * NOWN + o],
+                  op_owner_inst[(b * NOP + j) * NOWN + o], &dir, &hier);
+          any_dir |= dir;
+          any_hier |= hier;
+        }
+        bits[2 * nru + j] |= (miss || !(any_dir || any_hier)) ? 1 : 0;
+        bits[2 * nru + NOP + j] |= (miss || !any_dir) ? 1 : 0;
+      }
+
+      // pack entry e's bits per owner_bit_layout
+      if (epw) {
+        uint32_t* word = b_words + e / epw;
+        int base = (e % epw) * ebits;
+        for (int k = 0; k < ebits; ++k)
+          if (bits[k]) *word |= 1u << (base + k);
+      } else {
+        for (int k = 0; k < ebits; ++k)
+          if (bits[k]) b_words[e * wpe + k / 32] |= 1u << (k % 32);
+      }
+    }
+  }
+}
+
 }  // extern "C"

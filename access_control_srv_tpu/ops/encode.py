@@ -232,6 +232,17 @@ class RequestBatch:
     # rows ineligible ONLY because a padding cap overflowed (native wire
     # encoder); the serving path re-encodes them at the ceiling shapes
     overcap: Optional[np.ndarray] = None
+    # pooled-staging lease (native zero-copy encode): a zero-arg callable
+    # returning this batch's buffers to their arena.  MUST only run after
+    # the consuming computation has materialized — on the CPU backend the
+    # device arrays can alias these buffers zero-copy.  None for batches
+    # built from fresh allocations.
+    staging: Optional[object] = None
+
+    def release_staging(self) -> None:
+        release, self.staging = self.staging, None
+        if release is not None:
+            release()
 
 
 class _RegexCache:
@@ -351,6 +362,28 @@ def alloc_row_arrays(B: int, caps: dict[str, int] | None = None
         "r_hr_roles": np.full((B, NHRR), ABSENT, np.int32),
         "r_subject_id": np.full((B,), ABSENT, np.int32),
     }
+
+
+# arrays alloc_row_arrays fills with ABSENT (everything else zero-fills);
+# reset_row_arrays must track alloc_row_arrays exactly so a recycled arena
+# buffer is indistinguishable from a fresh allocation
+_ABSENT_FILLED = frozenset({
+    "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
+    "r_ent_vals", "r_inst_run", "r_inst_owner_ent", "r_inst_owner_inst",
+    "r_prop_vals", "r_prop_sfx", "r_prop_run", "r_prop_tail", "r_op_vals",
+    "r_op_owner_ent", "r_op_owner_inst", "r_ra3", "r_ra2", "r_hr",
+    "r_acl_ent", "r_acl_inst", "r_acl_hr", "r_hr_roles", "r_subject_id",
+})
+
+
+def reset_row_arrays(a: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Re-fill recycled row arrays in place to the alloc_row_arrays fill
+    values (C-speed memset, zero allocation) — the native encoder writes
+    only the slots a request uses and relies on the rest holding the
+    fill value, so arena reuse must restore it."""
+    for name, arr in a.items():
+        arr.fill(ABSENT if name in _ABSENT_FILLED else 0)
+    return a
 
 
 def owner_bit_layout(rv: int, nru: int, nop: int) -> tuple[int, int, int, int]:

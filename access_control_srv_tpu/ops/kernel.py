@@ -1072,7 +1072,15 @@ class DecisionKernel:
         self._run = self._run_noacl
 
     def evaluate(self, batch: RequestBatch):
-        """Returns (decision, cacheable, status) numpy arrays [B].
+        """Returns (decision, cacheable, status) numpy arrays [B]."""
+        return self.evaluate_async(batch)()
+
+    def evaluate_async(self, batch: RequestBatch):
+        """Host prep + device dispatch WITHOUT blocking on the result;
+        returns a zero-arg callable that materializes the (decision,
+        cacheable, status) tuple — the dense-kernel leg of the depth-N
+        serving pipeline (srv/batcher.py overlaps the next batch's prep
+        with this batch's device execution).
 
         The batch axis is padded to a power-of-two bucket before entering
         jit: without bucketing every distinct batch size is a fresh XLA
@@ -1097,4 +1105,4 @@ class DecisionKernel:
             jnp.asarray(pad_cols(batch.cond_abort, bucket)),
             jnp.asarray(pad_cols(batch.cond_code, bucket)),
         )
-        return tuple(np.asarray(x)[:b] for x in out)
+        return lambda: tuple(np.asarray(x)[:b] for x in out)

@@ -45,6 +45,7 @@ import numpy as np
 
 from .compile import CompiledPolicies
 from .encode import RequestBatch
+from .staging import HostBufferPool, default_pool
 from .kernel import (
     DecisionKernel,
     _action_kind,
@@ -100,6 +101,19 @@ def _is_varying(name: str) -> bool:
 
 # rules below this count are cheaper to sweep densely than to group/compact
 MIN_RULES = 512
+
+_donate_ok_cache: Optional[bool] = None
+
+
+def donation_supported() -> bool:
+    """Donate the packed per-row device buffer to the sig runner so XLA
+    reuses its HBM for outputs.  CPU backend excluded: jnp/device_put can
+    alias host numpy memory zero-copy there, and donating an aliased
+    buffer would let XLA scribble over a pooled staging buffer."""
+    global _donate_ok_cache
+    if _donate_ok_cache is None:
+        _donate_ok_cache = jax.default_backend() in ("tpu", "gpu")
+    return _donate_ok_cache
 
 
 def candidate_rows(
@@ -208,7 +222,8 @@ class PrefilteredKernel:
     def __init__(self, compiled: CompiledPolicies, cache_size: int = 1024,
                  mesh=None, axis: str = "data", max_groups: int = 512,
                  telemetry=None, dynamic_policies: bool = False,
-                 shared_jits: Optional[dict] = None):
+                 shared_jits: Optional[dict] = None,
+                 staging: Optional[HostBufferPool] = None):
         """``mesh``: optional jax.sharding.Mesh — requests shard
         data-parallel over ``axis`` while the stacked subtrees and regex
         matrices replicate (the multi-chip layout of parallel/mesh.py
@@ -243,6 +258,12 @@ class PrefilteredKernel:
         self.telemetry = telemetry
         self.dynamic_policies = dynamic_policies
         self._shared = shared_jits if shared_jits is not None else {}
+        # pooled host staging (ops/staging.py): the packed sig-path row
+        # buffer and the slot/readback maps recycle across batches so a
+        # depth-N pipeline allocates nothing per batch on this path;
+        # buffers release at materialize (after the output fetch, which
+        # orders behind every consumer of the inputs)
+        self.staging = staging if staging is not None else default_pool()
         self._subs: dict[tuple, CompiledPolicies] = {}
         self._stacks: dict[tuple, dict[str, jnp.ndarray]] = {}
         self._bits: dict[tuple, dict[str, jnp.ndarray]] = {}
@@ -312,30 +333,40 @@ class PrefilteredKernel:
             self._runs[key] = run
         return run
 
-    def _wrap_runner(self, shared_key, body, shardings):
+    def _wrap_runner(self, shared_key, body, shardings, donate=()):
         """Jit ``body(c_inv, *args)``.  Dynamic mode: c_inv is a real
         argument and the jitted callable is shared across kernel swaps
         (same shapes -> same executable, zero recompiles per patch).
         Static mode: c_inv is baked as jit constants ([S,KP]-scale only),
-        exactly the pre-delta behavior."""
+        exactly the pre-delta behavior.
+
+        ``donate``: argnums of ``body`` (c_inv included in the numbering)
+        whose device buffers the caller gives up per call — XLA reuses
+        their memory for outputs.  Only honored on backends where
+        device_put copies (donation_supported); per-batch streaming
+        buffers are the intended donees."""
+        donate = tuple(donate) if donation_supported() else ()
         if not self.dynamic_policies:
             from functools import partial
 
             bound = partial(body, self._c_inv)
+            don_b = tuple(i - 1 for i in donate)
             if shardings is None:
-                return jax.jit(bound)
+                return jax.jit(bound, donate_argnums=don_b)
             return jax.jit(bound, in_shardings=shardings[0],
-                           out_shardings=shardings[1])
+                           out_shardings=shardings[1],
+                           donate_argnums=don_b)
         jitted = self._shared.get(shared_key)
         if jitted is None:
             if shardings is None:
-                jitted = jax.jit(body)
+                jitted = jax.jit(body, donate_argnums=donate)
             else:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
                 repl = NamedSharding(self.mesh, P())
                 jitted = jax.jit(body, in_shardings=(repl,) + shardings[0],
-                                 out_shardings=shardings[1])
+                                 out_shardings=shardings[1],
+                                 donate_argnums=donate)
             self._shared[shared_key] = jitted
         return lambda *args: jitted(self._c_inv, *args)
 
@@ -534,7 +565,11 @@ class PrefilteredKernel:
                 repl = NamedSharding(self.mesh, P())
                 data = NamedSharding(self.mesh, P(self.axis))
                 shardings = ((repl, repl, data, repl, data, repl), repl)
-            run = self._wrap_runner(key, body, shardings)
+            # the packed per-row buffer (arg 4: c_inv, cs, planes, slot_g,
+            # mega_rows, ...) is donated: it is per-batch streaming data
+            # the host never reads back, so XLA may reuse its HBM for the
+            # [NSLOT, 3, R] outputs (no-op on CPU — donation_supported)
+            run = self._wrap_runner(key, body, shardings, donate=(4,))
             self._runs[key] = run
         return run
 
@@ -764,8 +799,8 @@ class PrefilteredKernel:
         order of magnitude on the tunnel backend, so pipelining nearly
         doubles steady-state throughput."""
         if not self.active:
-            res = self._dense.evaluate(batch)
-            return lambda: res
+            # small trees: the dense/sharded kernel's own async dispatch
+            return self._dense.evaluate_async(batch)
 
         ents = np.asarray(batch.arrays["r_ent_vals"])  # [B, NR]
         cols = np.asarray(batch.arrays["r_ent_e"])     # [B, NR]
@@ -965,112 +1000,152 @@ class PrefilteredKernel:
                 tuple(keys), groups, stacked, (NR, NOP, NACT),
                 rgx_np, pfx_np,
             )
-            # pack the whole per-row side into ONE int32 buffer [B, W]
+            # pack the whole per-row side into ONE int32 buffer [B, W];
+            # the buffer (and the slot/readback maps below) comes from the
+            # staging pool and is released at materialize — the depth-N
+            # pipeline allocates nothing per batch on this path
             r_keys = _SIG_R_KEYS_HR if self.needs_hr else _SIG_R_KEYS
             schedule = []
-            parts = []
+            widths = []
             for k in r_keys:
                 a = np.asarray(batch.arrays[k])
                 tail = a.shape[1:]
                 w = int(np.prod(tail)) if tail else 1
-                parts.append(a.reshape(B, w).astype(np.int32))
+                widths.append(w)
                 schedule.append((k, w, tuple(tail)))
             C = batch.cond_true.shape[0]
-            for nm, arr in (("cond_true", batch.cond_true),
-                            ("cond_abort", batch.cond_abort),
-                            ("cond_code", batch.cond_code)):
-                parts.append(
-                    np.ascontiguousarray(np.asarray(arr).T).astype(np.int32)
-                )
+            for nm in ("cond_true", "cond_abort", "cond_code"):
                 schedule.append((nm, C, (C,)))
-            mega_rows = np.ascontiguousarray(np.concatenate(parts, axis=1))
-            W = mega_rows.shape[1]
+            W = sum(widths) + 3 * C
             # the runner's jit shapes must not track raw B: pad the row
             # buffer (and the readback map, below) to the half-pow2
             # bucket so varying serving batch sizes reuse compiles
             b_pad = half_pow2_bucket(B, floor=8)
-            if b_pad != B:
-                mega_rows = np.concatenate(
-                    [mega_rows, np.zeros((b_pad - B, W), np.int32)], axis=0
+            pool = self.staging
+            leases: list = []
+
+            def take(shape):
+                buf = pool.acquire(shape, np.int32)
+                leases.append(buf)
+                return buf
+
+            try:
+                mega_rows = take((b_pad, W))
+                off = 0
+                for k, w in zip(r_keys, widths):
+                    a = np.asarray(batch.arrays[k])
+                    np.copyto(mega_rows[:B, off:off + w], a.reshape(B, w),
+                              casting="unsafe")
+                    off += w
+                for arr in (batch.cond_true, batch.cond_abort,
+                            batch.cond_code):
+                    np.copyto(mega_rows[:B, off:off + C],
+                              np.asarray(arr).T, casting="unsafe")
+                    off += C
+                if b_pad != B:
+                    mega_rows[B:].fill(0)
+
+                # group-dense slot layout (see _sig_runner): rows sorted
+                # by signature, packed into [NSLOT, R] slots that each
+                # share one group; padding is bounded by G * R extra rows
+                # and oversized groups simply span multiple slots.  R
+                # derives from BUCKETED batch/group counts only (and
+                # nslot pads to half-pow2 buckets), so signature-mix skew
+                # cannot multiply compiled (ns_pad, R) shape variants of
+                # the heavy runner
+                G = uniq.shape[0]
+                gb = pow2_bucket(G, floor=1)
+                R = min(4096, pow2_bucket(
+                    max(8, 2 * pow2_bucket(B) // gb), floor=8,
+                ))
+                # near-unique signature mixes (G approaching B) would
+                # inflate the slot grid by the R floor; cap total padded
+                # rows at ~4x the bucketed batch so adversarial traffic
+                # degrades bounded (8-row sublane tile is the hard floor)
+                R = min(R, max(8, pow2_bucket(
+                    4 * pow2_bucket(B) // gb, floor=8,
+                )))
+                row_order = np.argsort(inv, kind="stable")
+                counts = np.bincount(inv, minlength=G)
+                slots_per_g = -(-counts // R)
+                slot_base = np.concatenate(([0], np.cumsum(slots_per_g)))
+                nslot = int(slot_base[-1])
+                ns_pad = half_pow2_bucket(nslot, floor=8)
+                if self.mesh is not None:
+                    n_data = self.mesh.shape[self.axis]
+                    if ns_pad % n_data:
+                        ns_pad = -(-ns_pad // n_data) * n_data
+                starts = np.concatenate(([0], np.cumsum(counts)))
+                rk = np.arange(B) - starts[inv[row_order]]
+                grid_pos = (
+                    (slot_base[inv[row_order]] + rk // R) * R + rk % R
+                ).astype(np.int64)
+                slot_g = take((ns_pad,))
+                slot_g.fill(0)
+                slot_g[:nslot] = np.repeat(
+                    np.arange(G, dtype=np.int32), slots_per_g
                 )
+                # device-side scatter maps: grid position -> source row
+                # (pad positions read row 0, discarded) and original row
+                # -> grid position (the readback gather); pooled, so the
+                # recycled buffers are zero-filled before the scatter
+                grid2row_flat = take((ns_pad * R,))
+                grid2row_flat.fill(0)
+                grid2row_flat[grid_pos] = row_order
+                grid2row = grid2row_flat.reshape(ns_pad, R)
+                gp_orig = take((b_pad,))
+                gp_orig.fill(0)
+                gp_orig[row_order] = grid_pos.astype(np.int32)
 
-            # group-dense slot layout (see _sig_runner): rows sorted by
-            # signature, packed into [NSLOT, R] slots that each share one
-            # group; padding is bounded by G * R extra rows and oversized
-            # groups simply span multiple slots.  R derives from BUCKETED
-            # batch/group counts only (and nslot pads to half-pow2
-            # buckets), so signature-mix skew cannot multiply compiled
-            # (ns_pad, R) shape variants of the heavy runner
-            G = uniq.shape[0]
-            gb = pow2_bucket(G, floor=1)
-            R = min(4096, pow2_bucket(
-                max(8, 2 * pow2_bucket(B) // gb), floor=8,
-            ))
-            # near-unique signature mixes (G approaching B) would inflate
-            # the slot grid by the R floor; cap total padded rows at
-            # ~4x the bucketed batch so adversarial traffic degrades
-            # bounded (8-row sublane tile is the hard floor)
-            R = min(R, max(8, pow2_bucket(
-                4 * pow2_bucket(B) // gb, floor=8,
-            )))
-            row_order = np.argsort(inv, kind="stable")
-            counts = np.bincount(inv, minlength=G)
-            slots_per_g = -(-counts // R)
-            slot_base = np.concatenate(([0], np.cumsum(slots_per_g)))
-            nslot = int(slot_base[-1])
-            ns_pad = half_pow2_bucket(nslot, floor=8)
-            if self.mesh is not None:
-                n_data = self.mesh.shape[self.axis]
-                if ns_pad % n_data:
-                    ns_pad = -(-ns_pad // n_data) * n_data
-            starts = np.concatenate(([0], np.cumsum(counts)))
-            rk = np.arange(B) - starts[inv[row_order]]
-            grid_pos = (
-                (slot_base[inv[row_order]] + rk // R) * R + rk % R
-            ).astype(np.int64)
-            slot_g = np.zeros(ns_pad, np.int32)
-            slot_g[:nslot] = np.repeat(
-                np.arange(G, dtype=np.int32), slots_per_g
-            )
-            # device-side scatter maps: grid position -> source row (pad
-            # positions read row 0, discarded) and original row -> grid
-            # position (the readback gather)
-            grid2row = np.zeros(ns_pad * R, np.int32)
-            grid2row[grid_pos] = row_order
-            grid2row = grid2row.reshape(ns_pad, R)
-            gp_orig = np.zeros(b_pad, np.int32)
-            gp_orig[row_order] = grid_pos.astype(np.int32)
-
-            # static: does ANY subject-bearing target row in this stack
-            # match by attribute pairs instead of role?
-            needs_pairs = bool(
-                (~np.asarray(stacked["t_has_role"])
-                 & (np.asarray(stacked["t_n_subjects"]) > 0)).any()
-            )
-            run = self._sig_runner(
-                tuple(schedule), needs_pairs, with_hr=self.needs_hr
-            )
-            cs = {k: v for k, v in stacked.items() if k in _SIG_C_KEYS}
-            # explicit async H2D put: handing the numpy buffers straight
-            # to pjit transfers them synchronously on the critical path
-            # (~10x slower for the packed buffer on the tunnel backend)
-            if self.mesh is None:
-                slot_g, mega_rows, grid2row, gp_orig = jax.device_put(
-                    (slot_g, mega_rows, grid2row, gp_orig)
+                # static: does ANY subject-bearing target row in this
+                # stack match by attribute pairs instead of role?
+                needs_pairs = bool(
+                    (~np.asarray(stacked["t_has_role"])
+                     & (np.asarray(stacked["t_n_subjects"]) > 0)).any()
                 )
-            else:
-                from jax.sharding import NamedSharding, PartitionSpec as P
+                run = self._sig_runner(
+                    tuple(schedule), needs_pairs, with_hr=self.needs_hr
+                )
+                cs = {k: v for k, v in stacked.items()
+                      if k in _SIG_C_KEYS}
+                # explicit async H2D put: handing the numpy buffers
+                # straight to pjit transfers them synchronously on the
+                # critical path (~10x slower for the packed buffer on the
+                # tunnel backend)
+                if self.mesh is None:
+                    slot_g_d, mega_rows_d, grid2row_d, gp_orig_d = \
+                        jax.device_put(
+                            (slot_g, mega_rows, grid2row, gp_orig)
+                        )
+                else:
+                    from jax.sharding import (
+                        NamedSharding,
+                        PartitionSpec as P,
+                    )
 
-                data = NamedSharding(self.mesh, P(self.axis))
-                repl = NamedSharding(self.mesh, P())
-                slot_g = jax.device_put(slot_g, data)
-                grid2row = jax.device_put(grid2row, data)
-                mega_rows = jax.device_put(mega_rows, repl)
-                gp_orig = jax.device_put(gp_orig, repl)
-            out_dev = run(cs, bits, slot_g, mega_rows, grid2row, gp_orig)
+                    data = NamedSharding(self.mesh, P(self.axis))
+                    repl = NamedSharding(self.mesh, P())
+                    slot_g_d = jax.device_put(slot_g, data)
+                    grid2row_d = jax.device_put(grid2row, data)
+                    mega_rows_d = jax.device_put(mega_rows, repl)
+                    gp_orig_d = jax.device_put(gp_orig, repl)
+                out_dev = run(cs, bits, slot_g_d, mega_rows_d, grid2row_d,
+                              gp_orig_d)
+            except BaseException:
+                # a failed dispatch (compile error, bad shapes) must not
+                # leak its leases — recurring errors would drain the pool
+                pool.release_all(leases)
+                raise
 
             def materialize():
+                # the output fetch orders after every consumer of the
+                # inputs, so the staging leases are safe to recycle only
+                # AFTER this line — releasing earlier could leak rows
+                # between batches on the zero-copy CPU backend
                 out = np.asarray(out_dev)  # [3, b_pad]
+                if leases:
+                    pool.release_all(leases)
+                    leases.clear()
                 return tuple(out[i][:B] for i in range(3))
 
             return materialize

@@ -1,0 +1,125 @@
+"""Pooled host staging buffers for the device pipeline.
+
+The depth-N serving pipeline (srv/batcher.py, srv/pipeline.py) keeps up
+to N batches in flight: batch i+1's host prep overlaps batch i's device
+execution and batch i-1's D2H/decode.  At that rate the per-batch numpy
+allocations of the hot path — the packed sig-path row buffer
+(ops/prefilter.py ``mega_rows``), the slot/readback maps, the native
+encoder's row arrays (native/__init__.py) — become both an allocator tax
+and a GC hazard, so they are recycled through this pool instead.
+
+Shapes are stable by construction: every pooled buffer's shape derives
+from power-of-two capacity buckets (ops/kernel.pow2_bucket /
+half_pow2_bucket and PR 4's capacity-bucketed table dims), so steady-state
+traffic cycles through a handful of (shape, dtype) keys and the pool hits
+~100% after warmup.
+
+Aliasing discipline — the ONLY correctness rule here: a leased buffer may
+be handed to ``jax.device_put`` / ``jnp.asarray``, which on the CPU
+backend can alias the numpy memory into the device array ZERO-COPY.  A
+buffer must therefore stay leased until every computation that may read
+it has completed — in practice, until the batch's ``materialize()`` has
+returned (the output fetch orders after every consumer of the inputs on
+the device stream).  ``release`` before that point can leak rows between
+batches; tests/test_pipeline.py's aliasing test drives exactly that
+protocol and the pool refuses double-release outright.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+class HostBufferPool:
+    """Thread-safe free-list of numpy buffers keyed by (shape, dtype).
+
+    ``acquire`` returns a leased buffer (recycled when one is free, else
+    freshly allocated); ``release`` returns it to the free list.  Buffers
+    are NOT cleared on either side — callers overwrite every byte they
+    read (the prefilter packs dense slices; the native arena re-fills with
+    the alloc_row_arrays fill values), which the aliasing test enforces.
+    """
+
+    def __init__(self, max_per_key: int = 8):
+        self.max_per_key = int(max_per_key)
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        # id(buffer) -> key, for every buffer currently leased out
+        self._leased: dict[int, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+        self.releases = 0
+
+    @staticmethod
+    def _key(shape, dtype) -> tuple:
+        return (tuple(shape), np.dtype(dtype).str)
+
+    def acquire(self, shape, dtype=np.int32) -> np.ndarray:
+        key = self._key(shape, dtype)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                buf = free.pop()
+                self.hits += 1
+                self._leased[id(buf)] = key
+                return buf
+            self.misses += 1
+        buf = np.empty(key[0], np.dtype(dtype))
+        with self._lock:
+            self._leased[id(buf)] = key
+        return buf
+
+    def release(self, buf: Optional[np.ndarray]) -> None:
+        """Return a leased buffer.  Double-release raises: handing the
+        same buffer to two batches is exactly the row-leak the pool must
+        make impossible."""
+        if buf is None:
+            return
+        with self._lock:
+            key = self._leased.pop(id(buf), None)
+            if key is None:
+                raise ValueError(
+                    "release of a buffer this pool has not leased "
+                    "(double release or foreign buffer)"
+                )
+            self.releases += 1
+            free = self._free.setdefault(key, [])
+            if len(free) < self.max_per_key:
+                free.append(buf)
+
+    def release_all(self, bufs) -> None:
+        for buf in bufs:
+            self.release(buf)
+
+    def leased_count(self) -> int:
+        with self._lock:
+            return len(self._leased)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "releases": self.releases,
+                "leased": len(self._leased),
+                "free": sum(len(v) for v in self._free.values()),
+                "keys": len(self._free),
+            }
+
+
+_default: Optional[HostBufferPool] = None
+_default_lock = threading.Lock()
+
+
+def default_pool() -> HostBufferPool:
+    """Process-wide pool shared by every kernel instance: capacity-stable
+    shapes mean kernel swaps (hot updates, PR 4) keep hitting the same
+    buffers instead of refilling a cold pool per swap."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = HostBufferPool()
+        return _default

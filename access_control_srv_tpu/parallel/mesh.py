@@ -128,6 +128,11 @@ class ShardedDecisionKernel:
             self._run = lambda *args: self._jit(self._c, *args)
 
     def evaluate(self, batch: RequestBatch):
+        return self.evaluate_async(batch)()
+
+    def evaluate_async(self, batch: RequestBatch):
+        """Dispatch without blocking; returns the materialize callable
+        (the data-parallel leg of the depth-N serving pipeline)."""
         arrays = dict(batch.arrays)
         arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
         arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
@@ -141,4 +146,4 @@ class ShardedDecisionKernel:
             jnp.asarray(batch.rgx_set),
             jnp.asarray(batch.pfx_neq),
         )
-        return tuple(np.asarray(x)[: batch.B] for x in out)
+        return lambda: tuple(np.asarray(x)[: batch.B] for x in out)

@@ -315,7 +315,13 @@ class RuleShardedKernel:
         self._run = jax.jit(wrapped)
 
     def evaluate(self, batch: RequestBatch):
-        """Batch and regex-matrix axes are padded to power-of-two buckets
+        return self.evaluate_async(batch)()
+
+    def evaluate_async(self, batch: RequestBatch):
+        """Dispatch without blocking (returns the materialize callable —
+        the rule-sharded leg of the depth-N serving pipeline).
+
+        Batch and regex-matrix axes are padded to power-of-two buckets
         (divisible by the data-axis size) before entering jit — the same
         scheme as DecisionKernel.evaluate, so serving traffic with varying
         batch sizes reuses a handful of compiled programs instead of
@@ -343,4 +349,4 @@ class RuleShardedKernel:
             jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
             jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
         )
-        return tuple(np.asarray(x)[: batch.B] for x in out)
+        return lambda: tuple(np.asarray(x)[: batch.B] for x in out)

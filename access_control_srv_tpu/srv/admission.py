@@ -60,9 +60,12 @@ SHUTDOWN_CODE = 503   # still queued when the drain deadline hit
 INTERACTIVE = "interactive"
 BULK = "bulk"
 
-# end-to-end batches a freshly-admitted request can wait behind: its own
-# collection round plus the batcher's depth-2 eval pipeline
-# (srv/batcher.py "one batch evaluating + one queued at most")
+# end-to-end batches a freshly-admitted request can wait behind at the
+# LEGACY depth-2 pipeline: its own collection round plus the batcher's
+# two in-flight batches.  The live value is per-controller
+# (``AdmissionController.pipeline_batches`` = configured
+# evaluator:pipeline_depth + 1) so deadline-feasibility math tracks the
+# real in-flight count at any depth; this constant remains the default.
 PIPELINE_BATCHES = 3
 
 # metadata key carrying a per-request timeout for clients that cannot set
@@ -350,10 +353,16 @@ class AdmissionController:
         min_batch: int = 64,
         drain_deadline_s: float = 5.0,
         bulk_interval: int = 4,
+        pipeline_depth: int = PIPELINE_BATCHES - 1,
         telemetry=None,
         time_fn=time.monotonic,
     ):
         self.enabled = bool(enabled)
+        # batches a fresh request can wait behind: its own collection
+        # round + the configured in-flight pipeline depth.  Shares the
+        # evaluator:pipeline_depth config value with the batcher so the
+        # feasibility estimate tracks the real in-flight count.
+        self.pipeline_batches = max(1, int(pipeline_depth)) + 1
         self.max_queue = {
             INTERACTIVE: int(max_queue_interactive),
             BULK: int(max_queue_bulk),
@@ -402,6 +411,9 @@ class AdmissionController:
             min_batch=block.get("min_batch", 64),
             drain_deadline_s=block.get("drain_deadline_s", 5.0),
             bulk_interval=block.get("bulk_interval", 4),
+            pipeline_depth=(cfg.get("evaluator") or {}).get(
+                "pipeline_depth", PIPELINE_BATCHES - 1
+            ) if hasattr(cfg, "get") else PIPELINE_BATCHES - 1,
             telemetry=telemetry,
         )
         controller._breaker_cfg = dict(block.get("breakers") or {})
@@ -471,7 +483,9 @@ class AdmissionController:
             per_row = ewma.estimate_per_row() or 0.0
             with self._lock:
                 queued_ahead = self._depth[cls]
-            estimate = estimate * PIPELINE_BATCHES + queued_ahead * per_row
+            estimate = (
+                estimate * self.pipeline_batches + queued_ahead * per_row
+            )
             if remaining < estimate * self.deadline_headroom:
                 self._count("deadline_rejected")
                 if self.telemetry is not None:
@@ -533,15 +547,15 @@ class AdmissionController:
 
     def observe_batch(self, cls: str, seconds: float, rows: int) -> None:
         """Feed the latency EWMA and adapt the effective max-batch.  A
-        request's end-to-end wait spans up to PIPELINE_BATCHES batch
+        request's end-to-end wait spans up to ``pipeline_batches`` batch
         evaluations, so the per-batch target is deadline_bound /
-        PIPELINE_BATCHES (with margin: /4): batches overshooting it halve
+        pipeline_batches (with margin: +1): batches overshooting it halve
         the collection cap; comfortable full batches (< half the target)
         grow it back toward the configured max."""
         self._ewma[cls].observe(seconds, rows)
         if cls != INTERACTIVE or not self.adaptive_max_batch:
             return
-        target = self.deadline_bound_s / (PIPELINE_BATCHES + 1)
+        target = self.deadline_bound_s / (self.pipeline_batches + 1)
         with self._lock:
             current = self._adaptive_max
             if current is None:
@@ -592,6 +606,7 @@ class AdmissionController:
         with self._lock:
             out = {
                 "enabled": self.enabled,
+                "pipeline_batches": self.pipeline_batches,
                 "draining": self._draining,
                 **self._stats,
                 "queue_depth": dict(self._depth),

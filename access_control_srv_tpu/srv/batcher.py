@@ -61,12 +61,28 @@ class MicroBatcher:
         min_kernel_batch: int = 8,
         admission: Optional[AdmissionController] = None,
         observability=None,
+        pipeline_depth: int = 2,
     ):
         self.evaluator = evaluator
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self.min_kernel_batch = min_kernel_batch
         self.admission = admission
+        # device pipeline depth (config evaluator:pipeline_depth — the
+        # same value admission's feasibility estimate reads).  Depth <= 2
+        # is the LEGACY path, byte-identical to pre-pipeline behavior:
+        # blocking evaluate on the eval worker, at most depth batches in
+        # flight.  Depth > 2 splits evaluation into dispatch (encode +
+        # device enqueue, on the eval worker, in collection order) and
+        # finalize (materialize + decode + future resolution, on a
+        # dedicated worker, FIFO) so H2D/eval of batch i overlaps prep of
+        # i+1 and decode of i-1 — requires the evaluator's async split
+        # (HybridEvaluator.is_allowed_batch_async).
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._async_pipeline = (
+            self.pipeline_depth > 2
+            and hasattr(evaluator, "is_allowed_batch_async")
+        )
         # observability hub (srv/tracing.Observability): records the
         # admission and queue-wait stages.  None keeps submit/dispatch on
         # the exact pre-observability path.
@@ -82,6 +98,7 @@ class MicroBatcher:
         self._drain_deadline: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._eval_pool: Optional[ThreadPoolExecutor] = None
+        self._finalize_pool: Optional[ThreadPoolExecutor] = None
         self._inflight: list = []  # evaluation futures, FIFO
         self._last_batch = 0  # previous round's size (regime detector)
         self._rounds_since_bulk = 0
@@ -93,6 +110,12 @@ class MicroBatcher:
             self._eval_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="acs-batch-eval"
             )
+            if self._async_pipeline:
+                # finalize worker: materializes device results, decodes
+                # and resolves caller futures in dispatch order (FIFO)
+                self._finalize_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="acs-batch-finalize"
+                )
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
 
@@ -117,6 +140,9 @@ class MicroBatcher:
         if self._eval_pool is not None:
             self._eval_pool.shutdown(wait=True)
             self._eval_pool = None
+        if self._finalize_pool is not None:
+            self._finalize_pool.shutdown(wait=True)
+            self._finalize_pool = None
         self._inflight = []
         # anything the drain loop could not flush before the deadline:
         # resolve with the shutdown status instead of leaving the caller's
@@ -349,13 +375,98 @@ class MicroBatcher:
                 prepare([req for req, _, _ in batch])
             except Exception:
                 pass
-        # bounded pipeline: one batch evaluating + one queued at most
-        while len(self._inflight) >= 2:
+        # bounded pipeline: at most pipeline_depth batches between
+        # collection and decode completion (legacy depth 2: one batch
+        # evaluating + one queued at most)
+        while len(self._inflight) >= self._inflight_bound():
             self._inflight.pop(0).result()
         self._inflight = [f for f in self._inflight if not f.done()]
-        self._inflight.append(
-            self._eval_pool.submit(self._eval_batch, batch)
-        )
+        if self._async_pipeline:
+            # dispatch/finalize split: encode + device enqueue runs on
+            # the eval worker in collection order; materialize + decode +
+            # future resolution on the finalize worker, FIFO — device
+            # execution of batch i overlaps dispatch of i+1 and decode
+            # of i-1
+            done: Future = Future()
+            self._eval_pool.submit(self._dispatch_async, batch, done)
+            self._inflight.append(done)
+        else:
+            self._inflight.append(
+                self._eval_pool.submit(self._eval_batch, batch)
+            )
+
+    def _inflight_bound(self) -> int:
+        """Depth bound on batches between collection and finalize: the
+        configured pipeline depth on the async path, the legacy bound
+        (at most 2: one evaluating + one queued) otherwise — depth 1
+        degenerates to fully synchronous dispatch either way."""
+        if self._async_pipeline:
+            return self.pipeline_depth
+        return min(self.pipeline_depth, 2)
+
+    def _dispatch_async(self, batch: list, done: "Future") -> None:
+        """Dispatch stage (eval worker): drop rows that expired while the
+        pipeline was full, run the evaluator's dispatch half (prepare /
+        cache lookups / encode + device enqueue), then hand the finalize
+        half to the finalize worker.  ``done`` resolves when the batch is
+        fully finalized — the collector's depth bound waits on it."""
+        t0 = time.perf_counter()
+        try:
+            if self.admission is not None:
+                batch = self._drop_expired(
+                    batch,
+                    margin_s=self.admission.estimate_high(INTERACTIVE),
+                )
+                if not batch:
+                    done.set_result(None)
+                    return
+            finalize = None
+            if len(batch) >= self.min_kernel_batch:
+                try:
+                    finalize = self.evaluator.is_allowed_batch_async(
+                        [req for req, _, _ in batch]
+                    )
+                except Exception:
+                    # poisoned dispatch: fall back per-request at finalize
+                    finalize = None
+            self._finalize_pool.submit(
+                self._finalize_batch, batch, finalize, t0, done
+            )
+        except BaseException:
+            if not done.done():
+                done.set_result(None)
+            raise
+
+    def _finalize_batch(self, batch: list, finalize, t0: float,
+                        done: "Future") -> None:
+        """Finalize stage (finalize worker, FIFO): materialize the device
+        result, decode, resolve caller futures — the async twin of
+        ``_eval_batch``'s resolution half."""
+        try:
+            responses = None
+            if finalize is not None:
+                try:
+                    responses = finalize()
+                except Exception:
+                    # one poisoned request must not deny the whole batch
+                    responses = None
+            if responses is not None:
+                for (_, future, _), response in zip(batch, responses):
+                    future.set_result(response)
+            else:
+                for req, future, _ in batch:
+                    try:
+                        future.set_result(self.evaluator.is_allowed(req))
+                    except Exception as err:
+                        if not future.done():
+                            future.set_exception(err)
+            if self.admission is not None:
+                self.admission.observe_batch(
+                    INTERACTIVE, time.perf_counter() - t0, len(batch)
+                )
+        finally:
+            if not done.done():
+                done.set_result(None)
 
     def _drop_expired(self, batch: list, margin_s: float = 0.0) -> list:
         """Rows whose deadline passed while queued resolve with the
@@ -423,7 +534,7 @@ class MicroBatcher:
             items = self._drop_expired_bulk(items)
         if not items:
             return
-        while len(self._inflight) >= 2:
+        while len(self._inflight) >= self._inflight_bound():
             self._inflight.pop(0).result()
         self._inflight = [f for f in self._inflight if not f.done()]
         self._inflight.append(

@@ -31,6 +31,17 @@ DEFAULT_CONFIG: dict = {
         "backend": "hybrid",  # oracle | kernel | hybrid
         "micro_batch_window_ms": 2,
         "micro_batch_max": 4096,
+        # device pipeline depth — the SINGLE source of truth for how many
+        # batches may be in flight between collection and decode.  Read by
+        # the micro-batcher (srv/batcher.py), the streaming wire pipeline
+        # (srv/pipeline.py) and admission control's deadline-feasibility
+        # estimate (srv/admission.py: pipeline_batches = depth + 1), so
+        # rejection math always tracks the real in-flight count.  2 is
+        # the legacy depth (one batch evaluating + one queued): the
+        # serving path is then byte-identical to pre-pipeline behavior.
+        # Depth N>2 turns on the dispatch/finalize split: H2D+eval of
+        # batch i overlaps prep of i+1 and decode/serialize of i-1.
+        "pipeline_depth": 2,
         # incremental policy updates (ops/delta.py): capacity-bucketed
         # tables, in-place CRUD patching without XLA recompiles, scoped
         # decision-cache invalidation.  Disable to force the pre-delta

@@ -466,6 +466,22 @@ class HybridEvaluator:
         native encoder is unavailable (caller falls back to the pb path).
         ``span`` is the RPC-level span from the transport (the native
         path has no Request objects to carry per-row spans)."""
+        finalize = self.is_allowed_batch_wire_async(messages, span=span)
+        return None if finalize is None else finalize()
+
+    def is_allowed_batch_wire_async(self, messages: list[bytes], span=None,
+                                    reuse: bool = False):
+        """Dispatch stage of the native wire path: encode (C++) + device
+        dispatch WITHOUT blocking, returning a zero-arg ``finalize`` that
+        materializes and yields (batch, decision, cacheable, status) — the
+        streaming pipeline (srv/pipeline.py) overlaps the next frame's
+        encode/dispatch with this frame's device execution and the
+        previous frame's decode.  None when the native path is
+        unavailable (caller falls back to pb parsing).
+
+        ``reuse=True`` encodes into pooled staging buffers; the CALLER
+        must fire ``batch.release_staging()`` once it has finished reading
+        the batch (after response assembly), never before."""
         with self._lock:
             kernel = self._kernel
             encoder = self._native_encoder
@@ -473,53 +489,58 @@ class HybridEvaluator:
             return None
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
-        batch = encoder.encode_wire(messages)
+        batch = encoder.encode_wire(messages, reuse=reuse)
         if tracer is not None:
             from .tracing import STAGE_WIRE_ENCODE
 
             now = time.perf_counter()
             tracer.record(span, STAGE_WIRE_ENCODE, now - t_stage)
-            t_stage = now
-        decision, cacheable, status = kernel.evaluate(batch)
-        if tracer is not None:
-            from .tracing import STAGE_DEVICE
+        t_device = time.perf_counter()
+        materialize = kernel.evaluate_async(batch)
 
-            tracer.record(span, STAGE_DEVICE,
-                          time.perf_counter() - t_stage)
-        if batch.overcap is not None and batch.overcap.any():
-            # adaptive caps, native path: rows that overflowed the floor
-            # shapes re-encode natively at the ceiling (one extra native
-            # call + one extra kernel dispatch for the rare deep rows)
-            # instead of falling back to the scalar oracle
-            from ..ops.encode import _CAPS_CEIL
+        def finalize():
+            decision, cacheable, status = materialize()
+            if tracer is not None:
+                from .tracing import STAGE_DEVICE
 
-            idx = [
-                b for b in range(len(messages))
-                if batch.overcap[b] and not batch.eligible[b]
-            ]
-            retry = encoder.encode_wire(
-                [messages[b] for b in idx], caps=dict(_CAPS_CEIL)
+                tracer.record(span, STAGE_DEVICE,
+                              time.perf_counter() - t_device)
+            if batch.overcap is not None and batch.overcap.any():
+                # adaptive caps, native path: rows that overflowed the
+                # floor shapes re-encode natively at the ceiling (one
+                # extra native call + one extra kernel dispatch for the
+                # rare deep rows) instead of falling back to the oracle
+                from ..ops.encode import _CAPS_CEIL
+
+                idx = [
+                    b for b in range(len(messages))
+                    if batch.overcap[b] and not batch.eligible[b]
+                ]
+                retry = encoder.encode_wire(
+                    [messages[b] for b in idx], caps=dict(_CAPS_CEIL)
+                )
+                d2, c2, s2 = kernel.evaluate(retry)
+                # kernel outputs are read-only views on device buffers
+                decision = np.array(decision)
+                cacheable = np.array(cacheable)
+                status = np.array(status)
+                n_retried = 0
+                for j, b in enumerate(idx):
+                    if retry.eligible[j]:
+                        batch.eligible[b] = True
+                        decision[b] = d2[j]
+                        cacheable[b] = c2[j]
+                        status[b] = s2[j]
+                        n_retried += 1
+                self._count_path("native-wire-ceil", n_retried)
+            n_served = sum(
+                1 for b in range(len(messages))
+                if batch.eligible[b] and status[b] == 200
             )
-            d2, c2, s2 = kernel.evaluate(retry)
-            # kernel outputs are read-only views on device buffers
-            decision = np.array(decision)
-            cacheable = np.array(cacheable)
-            status = np.array(status)
-            n_retried = 0
-            for j, b in enumerate(idx):
-                if retry.eligible[j]:
-                    batch.eligible[b] = True
-                    decision[b] = d2[j]
-                    cacheable[b] = c2[j]
-                    status[b] = s2[j]
-                    n_retried += 1
-            self._count_path("native-wire-ceil", n_retried)
-        n_served = sum(
-            1 for b in range(len(messages))
-            if batch.eligible[b] and status[b] == 200
-        )
-        self._count_path("native-wire", n_served)
-        return batch, decision, cacheable, status
+            self._count_path("native-wire", n_served)
+            return batch, decision, cacheable, status
+
+        return finalize
 
     # ------------------------------------------------- host-side pipeline
 
@@ -829,24 +850,42 @@ class HybridEvaluator:
         facade) short-circuit with the deadline status before any
         evaluation: the caller has abandoned the answer, so neither the
         device nor the oracle burns time on it, and nothing is cached."""
+        return self.is_allowed_batch_async(requests)()
+
+    def is_allowed_batch_async(self, requests: list):
+        """Dispatch stage of the depth-N batcher pipeline: expired-row
+        shed, host eligibility pipeline, cache lookups and encode + device
+        DISPATCH run now; the returned zero-arg ``finalize`` blocks on the
+        device result, decodes, runs oracle fallback rows and writes the
+        cache through.  Calling it immediately is byte-identical to the
+        synchronous path (the depth<=2 legacy batcher does exactly that);
+        deferring it lets the next batch's dispatch overlap this batch's
+        device execution (srv/batcher.py, depth>2)."""
         expired = self._expired_rows(requests)
         if expired:
             from .admission import DEADLINE_CODE, overload_response
 
             live = [r for b, r in enumerate(requests) if b not in expired]
-            computed = iter(self.is_allowed_batch(live) if live else [])
+            fin_live = (
+                self.is_allowed_batch_async(live) if live else (lambda: [])
+            )
             self._count_path("deadline-expired", len(expired))
             shed = overload_response(
                 DEADLINE_CODE, "deadline expired before evaluation"
             )
-            return [
-                shed if b in expired else next(computed)
-                for b in range(len(requests))
-            ]
+
+            def finalize_expired():
+                computed = iter(fin_live())
+                return [
+                    shed if b in expired else next(computed)
+                    for b in range(len(requests))
+                ]
+
+            return finalize_expired
         self.prepare_batch(requests)
         cache = self.decision_cache
         if cache is None or not cache.enabled:
-            return self._is_allowed_batch_uncached(requests)
+            return self._uncached_async_entry(requests)
         subject_urn = self.engine.urns.get("subjectID") or ""
         # one epoch snapshot for the whole batch, taken before any row
         # reads the tree: rows whose evaluation spans a concurrent epoch
@@ -879,10 +918,14 @@ class HybridEvaluator:
                 if response is not None:
                     response._path = "cache-hit"
         self._count_path("cache-hit", len(requests) - len(misses))
-        if misses:
-            computed = self._is_allowed_batch_uncached(
-                [requests[b] for b in misses]
-            )
+        if not misses:
+            return lambda: responses
+        fin_misses = self._uncached_async_entry(
+            [requests[b] for b in misses]
+        )
+
+        def finalize_cached():
+            computed = fin_misses()
             for j, b in enumerate(misses):
                 responses[b] = computed[j]
                 # write-through from BOTH serving paths: kernel rows and
@@ -890,19 +933,39 @@ class HybridEvaluator:
                 # cacheable 200s
                 cache.put(keys[b], computed[j], epoch=epoch,
                           features=self._request_features(requests[b]))
-        return responses
+            return responses
+
+        return finalize_cached
 
     def _is_allowed_batch_uncached(self, requests: list) -> list[Response]:
+        return self._is_allowed_batch_uncached_async(requests)()
+
+    def _uncached_async_entry(self, requests: list):
+        """Route through the SYNC uncached path when a subclass or test
+        double overrode it (the async split must not silently bypass an
+        interposed implementation); the real dispatch/finalize split
+        otherwise."""
+        sync = self._is_allowed_batch_uncached
+        if getattr(sync, "__func__", None) is not \
+                HybridEvaluator._is_allowed_batch_uncached:
+            return lambda: sync(requests)
+        return self._is_allowed_batch_uncached_async(requests)
+
+    def _is_allowed_batch_uncached_async(self, requests: list):
         with self._lock:
             kernel = self._kernel
             compiled = self._compiled
         if self.backend == "oracle" or kernel is None:
-            self._count_path("oracle", len(requests))
             # candidate-filtered like every other oracle path (skipped
             # rules provably cannot target-match; bit-identical) — the
             # unfiltered walk costs O(total rules) per row, ~21 ms on a
-            # 10k-rule tree vs sub-ms filtered
-            return [self._oracle_is_allowed(r) for r in requests]
+            # 10k-rule tree vs sub-ms filtered.  Host-only: nothing to
+            # overlap, so the walk runs at finalize.
+            def run_oracle():
+                self._count_path("oracle", len(requests))
+                return [self._oracle_is_allowed(r) for r in requests]
+
+            return run_oracle
 
         # mixed-traffic split: a handful of deep/wide rows must not
         # inflate the adaptive padding caps (and device cost) of the whole
@@ -919,36 +982,63 @@ class HybridEvaluator:
                 ext_set = set(ext)
                 floor_rows = [b for b in range(len(requests))
                               if b not in ext_set]
-                out: list[Response] = [None] * len(requests)
-                for rows, caps in ((floor_rows, dict(_CAPS_FLOOR)),
-                                   (ext, None)):
-                    sub = self._eval_encoded(
+                # both sub-batches dispatch back-to-back (they ride the
+                # same device queue), then finalize in dispatch order
+                fins = [
+                    (rows, self._eval_encoded_async(
                         kernel, compiled, [requests[b] for b in rows], caps
-                    )
-                    for b, resp in zip(rows, sub):
-                        out[b] = resp
-                return out
-        return self._eval_encoded(kernel, compiled, requests, None)
+                    ))
+                    for rows, caps in ((floor_rows, dict(_CAPS_FLOOR)),
+                                       (ext, None))
+                ]
+
+                def finalize_split():
+                    out: list[Response] = [None] * len(requests)
+                    for rows, fin in fins:
+                        for b, resp in zip(rows, fin()):
+                            out[b] = resp
+                    return out
+
+                return finalize_split
+        return self._eval_encoded_async(kernel, compiled, requests, None)
 
     def _eval_encoded(self, kernel, compiled, requests: list, caps):
+        return self._eval_encoded_async(kernel, compiled, requests, caps)()
+
+    def _eval_encoded_async(self, kernel, compiled, requests: list, caps):
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
         batch = encode_requests(
             requests, compiled, self.engine.resource_adapter, caps=caps
         )
         if tracer is not None:
-            from .tracing import STAGE_DEVICE, STAGE_ENCODE
+            from .tracing import STAGE_ENCODE
 
             now = time.perf_counter()
             tracer.fan_out(requests, STAGE_ENCODE, now - t_stage)
-            t_stage = now
-        decision, cacheable, status = kernel.evaluate(batch)
+        t_device = time.perf_counter()
+        materialize = kernel.evaluate_async(batch)
+
+        def finalize():
+            return self._decode_batch(
+                requests, batch, materialize(), tracer, t_device
+            )
+
+        return finalize
+
+    def _decode_batch(self, requests, batch, outputs, tracer, t_device):
+        decision, cacheable, status = outputs
+        t_stage = 0.0
         if tracer is not None:
-            # the kernel's evaluate() spans H2D transfer, device dispatch
+            from .tracing import STAGE_DEVICE
+
+            # dispatch->materialize spans H2D transfer, device dispatch
             # and the D2H fetch — attributed as one ``device`` stage (the
-            # host/device boundary; docs/OBSERVABILITY.md)
+            # host/device boundary; docs/OBSERVABILITY.md).  Pipelined
+            # callers overlap it with neighbor batches' host stages; the
+            # attribution stays wall time from dispatch to fetch.
             now = time.perf_counter()
-            tracer.fan_out(requests, STAGE_DEVICE, now - t_stage)
+            tracer.fan_out(requests, STAGE_DEVICE, now - t_device)
             t_stage = now
         n_oracle = sum(
             1 for b in range(len(requests))

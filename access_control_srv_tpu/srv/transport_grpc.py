@@ -372,6 +372,67 @@ def serialize_batch_response(responses: list) -> bytes:
     return b"".join(_SER_POOL.map(_response_frames, chunks))
 
 
+def decode_native_rows(messages: list[bytes], out) -> tuple:
+    """Per-row pb.Response assembly from a native wire result
+    ``(batch, decision, cacheable, status)``.  Ineligible / non-200 rows
+    are parsed back to Request models and returned for ONE batched
+    fallback call (resolve_fallback_rows) instead of per-row service
+    round-trips.  Shared by the unary IsAllowedBatch handler and the
+    streaming pipeline (srv/pipeline.py)."""
+    batch, decision, cacheable, status = out
+    responses: list = [None] * len(messages)
+    fallback_rows: list[int] = []
+    fallback_reqs: list = []
+    for b, message in enumerate(messages):
+        if not batch.eligible[b] or status[b] != 200:
+            try:
+                req = request_from_pb(pb.Request.FromString(message))
+            except Exception as err:
+                responses[b] = pb.Response(
+                    decision=pb.DENY,
+                    operation_status=pb.OperationStatus(
+                        code=500, message=str(err)
+                    ),
+                )
+                continue
+            fallback_rows.append(b)
+            fallback_reqs.append(req)
+            continue
+        cach = (
+            False if cacheable[b] < 0 else bool(cacheable[b])
+        )
+        responses[b] = pb.Response(
+            decision=DECISION_TO_PB[
+                DECISION_NAMES[int(decision[b])]
+            ],
+            evaluation_cacheable=cach,
+            operation_status=pb.OperationStatus(
+                code=200, message="success"
+            ),
+        )
+    return responses, fallback_rows, fallback_reqs
+
+
+def resolve_fallback_rows(worker, responses: list, fallback_rows: list,
+                          fallback_reqs: list, deadline, span=None) -> None:
+    """Resolve the rows decode_native_rows could not serve natively with
+    one batched service call (observe=False: the caller records
+    batch-level telemetry for ALL rows itself)."""
+    if not fallback_reqs:
+        return
+    if span is not None:
+        for req in fallback_reqs:
+            req._span = span
+            req._sampling_done = True
+    for b, resp in zip(
+        fallback_rows,
+        worker.service.is_allowed_batch(
+            fallback_reqs, observe=False, deadline=deadline,
+        ),
+    ):
+        responses[b] = response_to_pb(resp)
+
+
 def _unary(handler, req_cls, resp_cls):
     return grpc.unary_unary_rpc_method_handler(
         handler,
@@ -484,61 +545,21 @@ class GrpcServer:
                 if out is not None:
                     if tracer is not None:
                         t_stage = _time.perf_counter()
-                    batch, decision, cacheable, status = out
-                    responses: list = [None] * len(messages)
-                    fallback_rows: list[int] = []
-                    fallback_reqs: list = []
-                    for b, message in enumerate(messages):
-                        if not batch.eligible[b] or status[b] != 200:
-                            # collect fallback rows for ONE batched oracle
-                            # call (per-row service.is_allowed would wait
-                            # out a micro-batch window each)
-                            try:
-                                req = request_from_pb(
-                                    pb.Request.FromString(message)
-                                )
-                            except Exception as err:
-                                responses[b] = pb.Response(
-                                    decision=pb.DENY,
-                                    operation_status=pb.OperationStatus(
-                                        code=500, message=str(err)
-                                    ),
-                                )
-                                continue
-                            fallback_rows.append(b)
-                            fallback_reqs.append(req)
-                            continue
-                        cach = (
-                            False if cacheable[b] < 0 else bool(cacheable[b])
-                        )
-                        responses[b] = pb.Response(
-                            decision=DECISION_TO_PB[
-                                DECISION_NAMES[int(decision[b])]
-                            ],
-                            evaluation_cacheable=cach,
-                            operation_status=pb.OperationStatus(
-                                code=200, message="success"
-                            ),
-                        )
+                    # per-row assembly + ONE batched fallback call for
+                    # ineligible rows (per-row service.is_allowed would
+                    # wait out a micro-batch window each); observe=False
+                    # on the fallback: this handler records batch_latency
+                    # and decision counts for ALL rows below
+                    responses, fallback_rows, fallback_reqs = \
+                        decode_native_rows(messages, out)
                     if tracer is not None:
                         now = _time.perf_counter()
                         tracer.record(span, STAGE_DECODE, now - t_stage)
                         t_stage = now
-                    if fallback_reqs:
-                        if span is not None:
-                            for req in fallback_reqs:
-                                req._span = span
-                                req._sampling_done = True
-                        # observe=False: this handler records batch_latency
-                        # and decision counts for ALL rows below
-                        for b, resp in zip(
-                            fallback_rows,
-                            worker.service.is_allowed_batch(
-                                fallback_reqs, observe=False,
-                                deadline=deadline,
-                            ),
-                        ):
-                            responses[b] = response_to_pb(resp)
+                    resolve_fallback_rows(
+                        worker, responses, fallback_rows, fallback_reqs,
+                        deadline, span=span,
+                    )
                     telemetry = getattr(worker, "telemetry", None)
                     if telemetry is not None:
                         telemetry.batch_latency.observe(
@@ -581,6 +602,58 @@ class GrpcServer:
                           _time.perf_counter() - t_stage)
             return finish_rpc(payload)
 
+        def is_allowed_stream(request_iterator, context):
+            """Streaming batch endpoint: a stream of BatchRequest
+            envelopes in, a stream of BatchResponse frames out — one
+            response frame per request frame, IN FRAME ORDER per stream,
+            while frames from ALL streams share one depth-bounded device
+            pipeline (srv/pipeline.py).  A feeder thread consumes the
+            request iterator (submit's backpressure bounds it at the
+            pipeline depth) so response frames flush the moment they
+            complete instead of waiting for the next request frame —
+            a client that awaits response i before sending i+1 cannot
+            deadlock."""
+            import queue as _queue
+            import threading as _threading
+
+            pipeline = getattr(worker, "wire_pipeline", None)
+            deadline = deadline_from_context(context)
+            tracer = obs.tracer if obs is not None else None
+            if pipeline is None:
+                for raw in request_iterator:
+                    yield is_allowed_batch(raw, context)
+                return
+            frames: "_queue.Queue" = _queue.Queue()
+
+            def feed():
+                try:
+                    for raw in request_iterator:
+                        span = None
+                        if tracer is not None:
+                            span = tracer.start_span(
+                                trace_id_from_metadata(context)
+                            )
+                        frames.put(
+                            (pipeline.submit(raw, deadline, span=span),
+                             span)
+                        )
+                except BaseException as err:  # noqa: BLE001
+                    frames.put(err)
+                frames.put(None)
+
+            _threading.Thread(target=feed, daemon=True).start()
+            while True:
+                item = frames.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                future, span = item
+                payload = future.result()
+                if tracer is not None and span is not None:
+                    tracer.finish(span, code=200)
+                yield payload
+
         def what_is_allowed(request, context):
             rq = worker.service.what_is_allowed(
                 request_from_pb(request),
@@ -605,6 +678,16 @@ class GrpcServer:
             # (serialize_batch_response)
             "IsAllowedBatch": grpc.unary_unary_rpc_method_handler(
                 is_allowed_batch,
+                request_deserializer=lambda raw: raw,
+                response_serializer=lambda msg: (
+                    msg if isinstance(msg, bytes)
+                    else msg.SerializeToString()
+                ),
+            ),
+            # streaming twin of IsAllowedBatch: raw frames in/out, one
+            # shared device pipeline behind every stream
+            "IsAllowedStream": grpc.stream_stream_rpc_method_handler(
+                is_allowed_stream,
                 request_deserializer=lambda raw: raw,
                 response_serializer=lambda msg: (
                     msg if isinstance(msg, bytes)
@@ -864,6 +947,20 @@ class GrpcClient:
     def is_allowed_batch(self, request: pb.BatchRequest) -> pb.BatchResponse:
         return self._call("acstpu.AccessControlService", "IsAllowedBatch",
                           request, pb.BatchResponse)
+
+    def is_allowed_stream(self, batches, timeout=None):
+        """Streaming batch call: ``batches`` is an iterable of
+        pb.BatchRequest messages (or pre-serialized envelope bytes);
+        yields one pb.BatchResponse per frame, in frame order."""
+        fn = self.channel.stream_stream(
+            "/acstpu.AccessControlService/IsAllowedStream",
+            request_serializer=lambda m: (
+                m if isinstance(m, (bytes, bytearray))
+                else m.SerializeToString()
+            ),
+            response_deserializer=pb.BatchResponse.FromString,
+        )
+        return fn(batches, timeout=timeout)
 
     def what_is_allowed(self, request: pb.Request) -> pb.ReverseQuery:
         return self._call("acstpu.AccessControlService", "WhatIsAllowed",

@@ -50,6 +50,7 @@ class Worker:
         self.service: Optional[AccessControlService] = None
         self.command_interface: Optional[CommandInterface] = None
         self.batcher: Optional[MicroBatcher] = None
+        self.wire_pipeline = None  # srv/pipeline.DevicePipeline
         self.bus: Optional[EventBus] = None
         self.subject_cache: Optional[SubjectCache] = None
         self.decision_cache = None
@@ -334,9 +335,21 @@ class Worker:
             max_batch=cfg.get("evaluator:micro_batch_max", 4096),
             admission=self.admission,
             observability=self.obs,
+            # single source of truth for in-flight depth — admission's
+            # feasibility estimate reads the same config value
+            pipeline_depth=cfg.get("evaluator:pipeline_depth", 2),
         )
         self.batcher.start()
         self.service.batcher = self.batcher
+
+        # streaming wire pipeline (srv/pipeline.py): one depth-bounded
+        # device queue shared by every IsAllowedStream client stream;
+        # same depth value as the batcher and admission
+        from .pipeline import DevicePipeline
+
+        self.wire_pipeline = DevicePipeline(
+            self, depth=cfg.get("evaluator:pipeline_depth", 2)
+        )
 
         # event listeners (reference: src/worker.ts:249-361)
         auth_topic.on(self._auth_listener)
@@ -380,6 +393,8 @@ class Worker:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "wire_pipeline", None) is not None:
+            self.wire_pipeline.stop()
         if self.batcher is not None:
             # graceful drain: stop admitting, flush already-admitted
             # batches bounded by the drain deadline, fail the rest with

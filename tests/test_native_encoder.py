@@ -468,3 +468,119 @@ def test_wire_path_serves_deep_hr_rows_via_ceiling():
     for b, req in enumerate(twins):
         expected = engine.is_allowed(req)
         assert decision[b] == DEC_CODE[expected.decision], b
+
+
+# ------------------------------------------------- owner-bit packer parity
+
+
+def _owner_bits_encoder():
+    """A native encoder over an HR-scoped tree (hrv vocab non-empty) whose
+    vocab the fuzz below overrides per case."""
+    import bench_all
+    from access_control_srv_tpu.ops.compile import compile_policies
+
+    if not native.available():
+        pytest.skip(f"native encoder unavailable: {native.build_error()}")
+    engine, _ = bench_all._stress_engine(600, scoped=True)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    return native.NativeBatchEncoder(compiled), compiled
+
+
+def test_owner_bits_native_matches_python_packer_on_wire_traffic():
+    """End-to-end parity on real wire traffic: the C++ packer's
+    r_own_runs/r_own_bits equal ops/encode.pack_owner_bitplanes over the
+    same raw arrays."""
+    from access_control_srv_tpu.ops import encode as pyenc
+
+    enc, compiled = _owner_bits_encoder()
+    orgs = [f"org-{j}" for j in range(5)]
+    rng = np.random.default_rng(3)
+    reqs = []
+    for i in range(48):
+        k = int(rng.integers(64))
+        tree = [{"id": orgs[0], "role": f"role-{i % 97}",
+                 "children": [{"id": o}
+                              for o in orgs[1:1 + int(rng.integers(4))]]}]
+        from .utils import URNS, build_request
+
+        reqs.append(build_request(
+            subject_id=f"u{i}", subject_role=f"role-{i % 97}",
+            role_scoping_entity=(
+                "urn:restorecommerce:acs:model:organization.Organization"
+            ),
+            role_scoping_instance=orgs[int(rng.integers(3))],
+            resource_type=(
+                f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+            ),
+            resource_id=f"res-{i}", action_type=URNS["read"],
+            owner_indicatory_entity=(
+                "urn:restorecommerce:acs:model:organization.Organization"
+            ),
+            owner_instance=orgs[int(rng.integers(5))],
+            hierarchical_scopes=tree,
+        ))
+    messages = [request_to_pb(r).SerializeToString() for r in reqs]
+    batch = enc.encode_wire(messages)
+    raw = {k: v for k, v in batch.arrays.items() if not k.startswith("r_own")}
+    ref = pyenc.pack_owner_bitplanes(raw, compiled)
+    np.testing.assert_array_equal(ref["r_own_runs"],
+                                  batch.arrays["r_own_runs"])
+    np.testing.assert_array_equal(ref["r_own_bits"],
+                                  batch.arrays["r_own_bits"])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_owner_bits_fuzz_matches_python_packer(seed):
+    """Structure-free fuzz: random raw row arrays (random shapes, random
+    ids including ABSENT) and a random role-scope vocab — the C++ packer
+    must be bit-identical to the Python packer on every case, including
+    wide-entry layouts (ebits > 32)."""
+    from types import SimpleNamespace
+
+    from access_control_srv_tpu.ops import encode as pyenc
+    from access_control_srv_tpu.ops.encode import alloc_row_arrays
+
+    enc, _ = _owner_bits_encoder()
+    rng = np.random.default_rng(seed)
+    B = int(rng.integers(1, 24))
+    caps = {
+        "NR": 4, "NI": int(rng.integers(1, 6)), "NP": 8, "NSUB": 8,
+        "NACT": 4, "NOP": int(rng.integers(1, 4)),
+        "NOWN": int(rng.integers(1, 5)), "NRA": int(rng.integers(1, 10)),
+        "NHR": int(rng.integers(1, 34)), "NROLE": 4, "NACLE": 4,
+        "NACLI": 8, "NHRR": 8,
+    }
+    a = alloc_row_arrays(B, caps)
+
+    def rand_into(name, lo=-1, hi=12):
+        arr = a[name]
+        arr[...] = rng.integers(lo, hi, size=arr.shape).astype(arr.dtype)
+
+    for name in ("r_inst_run", "r_inst_owner_ent", "r_inst_owner_inst",
+                 "r_op_vals", "r_op_owner_ent", "r_op_owner_inst",
+                 "r_ra3", "r_ra2", "r_hr"):
+        rand_into(name)
+    a["r_inst_run"][...] = rng.integers(-1, caps["NR"],
+                                        size=a["r_inst_run"].shape)
+    for name in ("r_inst_valid", "r_inst_present", "r_inst_has_owners",
+                 "r_op_present", "r_op_has_owners"):
+        a[name][...] = rng.integers(0, 2, size=a[name].shape).astype(bool)
+
+    # random vocab, sized to also exercise the multi-word layout:
+    # ebits = 2*(nru+NOP) can exceed 32 when NI (hence nru) is large
+    RV = int(rng.integers(1, 40))
+    hrv_role = rng.integers(-1, 12, size=RV).astype(np.int32)
+    hrv_scope = rng.integers(0, 12, size=RV).astype(np.int32)
+    enc._hrv_role = np.ascontiguousarray(hrv_role)
+    enc._hrv_scope = np.ascontiguousarray(hrv_scope)
+    fake_compiled = SimpleNamespace(arrays={
+        "hrv_role": hrv_role, "hrv_scope": hrv_scope,
+        "t_has_scoping": np.array([True]),
+        "t_n_subjects": np.array([1]),
+    })
+    ref = pyenc.pack_owner_bitplanes(a, fake_compiled)
+    got = enc.owner_bits_native(a, B)
+    np.testing.assert_array_equal(ref["r_own_runs"], got["r_own_runs"],
+                                  err_msg=f"seed {seed} runs")
+    np.testing.assert_array_equal(ref["r_own_bits"], got["r_own_bits"],
+                                  err_msg=f"seed {seed} bits")
