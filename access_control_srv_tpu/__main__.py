@@ -4,10 +4,14 @@ directory, worker.start, SIGINT -> worker.stop).
 
     python -m access_control_srv_tpu [--config-dir DIR] [--addr HOST:PORT]
     python -m access_control_srv_tpu --broker [--addr HOST:PORT]
+    python -m access_control_srv_tpu --router --replica H:P --replica H:P
+    python -m access_control_srv_tpu --cluster [--replicas N]
 
 ``--broker`` serves the cross-process event/cache broker (srv/broker.py)
 instead of a worker — the Kafka/Redis-role process of a multi-worker
-deployment.
+deployment.  ``--router`` serves a ClusterRouter (srv/router.py) over
+already-running replicas; ``--cluster`` brings up the whole local tier
+(broker + N replicas + router, parallel/cluster.py) in one command.
 """
 
 from __future__ import annotations
@@ -53,6 +57,24 @@ def main(argv: list[str] | None = None) -> int:
              "seconds (0 = every record); default keeps flush-only "
              "semantics — a host crash can drop the flushed tail",
     )
+    parser.add_argument(
+        "--router", action="store_true",
+        help="serve a cluster router (srv/router.py) over running "
+             "replicas instead of a worker",
+    )
+    parser.add_argument(
+        "--replica", action="append", default=None, metavar="HOST:PORT",
+        help="replica address for --router (repeatable)",
+    )
+    parser.add_argument(
+        "--cluster", action="store_true",
+        help="bring up the whole local cluster tier: broker + replicas "
+             "+ router (parallel/cluster.py)",
+    )
+    parser.add_argument(
+        "--replicas", default=None, type=int,
+        help="replica count for --cluster (default: cfg cluster:replicas)",
+    )
     args = parser.parse_args(argv)
 
     if args.addr is not None:
@@ -86,11 +108,49 @@ def main(argv: list[str] | None = None) -> int:
         broker.stop()
         return 0
 
+    if args.router:
+        from .srv.config import Config
+        from .srv.router import ClusterRouter
+
+        if not args.replica:
+            parser.error("--router requires at least one --replica")
+        cfg = Config.load(args.config_dir, env=args.env)
+        router = ClusterRouter(
+            args.replica,
+            addr=args.addr or cfg.get("cluster:router:addr", "127.0.0.1:0"),
+            cfg=cfg.get("cluster:router") or {},
+        ).start()
+        print(f"routing on {router.addr}", flush=True)
+        stop_event.wait()
+        router.stop()
+        return 0
+
+    if args.cluster:
+        from .parallel.cluster import LocalCluster
+        from .srv.config import Config
+
+        cfg = Config.load(args.config_dir, env=args.env)
+        cluster = LocalCluster(
+            n_replicas=args.replicas or cfg.get("cluster:replicas", 2),
+            seed_cfg=cfg.get("seed_data") or {},
+            router_cfg=cfg.get("cluster:router") or {},
+        ).start()
+        print(f"routing on {cluster.router.addr}", flush=True)
+        stop_event.wait()
+        cluster.stop()
+        return 0
+
     from .srv.config import Config
     from .srv.transport_grpc import GrpcServer
     from .srv.worker import Worker
 
     cfg = Config.load(args.config_dir, env=args.env)
+    # on-chip pods: one replica process per TPU host joins the jax
+    # distributed runtime before any device work (no-op when the
+    # cluster:distributed block is off — the default)
+    from .parallel.cluster import maybe_initialize_distributed
+
+    maybe_initialize_distributed(cfg)
     worker = Worker()
     try:
         worker.start(cfg)

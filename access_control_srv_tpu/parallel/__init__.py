@@ -1,5 +1,19 @@
-"""Device-mesh sharding for the batched evaluator."""
+"""Device-mesh sharding for the batched evaluator, and the pod-scale
+replica cluster (parallel/cluster.py + srv/router.py)."""
 
+from .cluster import (
+    LocalCluster,
+    ReplicaProcess,
+    maybe_initialize_distributed,
+)
 from .mesh import ShardedDecisionKernel, make_mesh, make_mesh2, pad_batch
 
-__all__ = ["ShardedDecisionKernel", "make_mesh", "make_mesh2", "pad_batch"]
+__all__ = [
+    "LocalCluster",
+    "ReplicaProcess",
+    "ShardedDecisionKernel",
+    "make_mesh",
+    "make_mesh2",
+    "maybe_initialize_distributed",
+    "pad_batch",
+]

@@ -1,0 +1,285 @@
+"""Cluster process management: N-replica bring-up on one host, and the
+on-chip pod hook.
+
+``LocalCluster`` is the whole serving tier in one object — a durable
+broker process, N worker replica processes (each a full Worker whose
+PolicyReplicator replays the broker's journaled CRUD log at boot and
+applies live frames through the delta path, srv/store.py), and a
+ClusterRouter (srv/router.py) front door.  Everything runs on CPU with
+plain subprocesses, so the tier is testable anywhere; on a TPU pod the
+same replicas run one per host with ``cluster:distributed`` enabled and
+``maybe_initialize_distributed`` wiring jax.distributed underneath.
+
+Convergence invariant (docs/CLUSTER.md): replicas that applied the same
+CRUD log prefix hold byte-identical compiled tables — the
+``program_identity`` command (policy epoch + table fingerprint) is the
+probe, and tests/test_cluster_chaos.py kills a replica mid-churn to
+prove a restarted process converges back to it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional
+
+
+def maybe_initialize_distributed(cfg, process_id: int | None = None) -> bool:
+    """``jax.distributed.initialize`` behind the ``cluster:distributed``
+    config block: on-chip pods (one replica process per TPU host) opt in
+    by setting ``enabled`` with the coordinator address and process
+    count; the CPU N-process tier keeps it off and pays nothing.
+    Returns True when distributed init actually ran."""
+    def get(path: str, default=None):
+        if hasattr(cfg, "get") and not isinstance(cfg, dict):
+            return cfg.get(path, default)
+        node = cfg
+        for part in path.split(":"):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    if not get("cluster:distributed:enabled", False):
+        return False
+    coordinator = get("cluster:distributed:coordinator", "127.0.0.1:8476")
+    num_processes = int(get("cluster:distributed:num_processes", 1))
+    if process_id is None:
+        process_id = int(os.environ.get("ACS_CLUSTER_PROCESS_ID", "0"))
+    try:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except Exception:  # noqa: BLE001 — single-host / already-initialized
+        return False
+
+
+def _spawn(args: list[str], ready_prefix: str, timeout_s: float,
+           cwd: Optional[str] = None, env: Optional[dict] = None):
+    """Start a CLI subprocess and wait for its ``ready_prefix`` stdout
+    line; returns (process, address).  A drain thread keeps consuming
+    stdout afterwards so the pipe never backpressures the child."""
+    proc = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=cwd, env=env,
+    )
+    addr = None
+    deadline = time.monotonic() + timeout_s
+    lines: list[str] = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith(ready_prefix):
+            addr = line[len(ready_prefix):].strip()
+            break
+    if addr is None:
+        proc.kill()
+        proc.wait(timeout=5)
+        raise RuntimeError(
+            f"subprocess never reported {ready_prefix!r}: "
+            f"{''.join(lines[-20:])!r}"
+        )
+
+    def drain(stream=proc.stdout):
+        try:
+            for _ in stream:
+                pass
+        except Exception:  # noqa: BLE001
+            pass
+
+    threading.Thread(target=drain, daemon=True).start()
+    return proc, addr
+
+
+class ReplicaProcess:
+    """One worker replica as a child process: its own config dir (written
+    here), its own gRPC port, booted through the ordinary CLI so the
+    process is exactly what production runs."""
+
+    def __init__(self, config: dict, base_dir: str, name: str,
+                 timeout_s: float = 120.0):
+        self.name = name
+        self.config_dir = os.path.join(base_dir, name)
+        os.makedirs(self.config_dir, exist_ok=True)
+        with open(os.path.join(self.config_dir, "config.json"), "w") as fh:
+            json.dump(config, fh, indent=1)
+        self.timeout_s = timeout_s
+        self.proc: Optional[subprocess.Popen] = None
+        self.addr: Optional[str] = None
+
+    def start(self) -> "ReplicaProcess":
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc, self.addr = _spawn(
+            [sys.executable, "-m", "access_control_srv_tpu",
+             "--config-dir", self.config_dir, "--addr", "127.0.0.1:0"],
+            "serving on ", self.timeout_s,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            env=env,
+        )
+        return self
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — the chaos path: no drain, no goodbye."""
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def stop(self, timeout_s: float = 15.0) -> None:
+        """SIGTERM — the graceful path (worker drains in-flight work)."""
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+class LocalCluster:
+    """Broker + N replicas + router, owned end to end.
+
+    ``seed_cfg`` (seed_data YAML paths) is loaded ONCE, by the cluster,
+    as CRUD frames emitted straight into the broker's journaled topics
+    before any replica boots — the journal, not the YAML, is the
+    cluster's durable policy store, so every replica (first boot or
+    chaos restart) converges by replaying the same log through its
+    PolicyReplicator and all replicas report the same policy epoch."""
+
+    def __init__(self, n_replicas: int = 2, seed_cfg: dict | None = None,
+                 cfg_extra: dict | None = None,
+                 router_cfg: dict | None = None,
+                 base_dir: str | None = None,
+                 replica_timeout_s: float = 120.0):
+        self.n_replicas = int(n_replicas)
+        self.seed_cfg = seed_cfg or {}
+        self.cfg_extra = cfg_extra or {}
+        self.router_cfg = router_cfg or {}
+        self._own_base = base_dir is None
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="acs-cluster-")
+        self.replica_timeout_s = replica_timeout_s
+        self.broker_proc: Optional[subprocess.Popen] = None
+        self.broker_addr: Optional[str] = None
+        self.replicas: list[ReplicaProcess] = []
+        self.router = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "LocalCluster":
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        broker_dir = os.path.join(self.base_dir, "broker")
+        os.makedirs(broker_dir, exist_ok=True)
+        self.broker_proc, self.broker_addr = _spawn(
+            [sys.executable, "-m", "access_control_srv_tpu", "--broker",
+             "--addr", "127.0.0.1:0", "--broker-data-dir", broker_dir],
+            "broker listening on ", 30.0, cwd=repo_root,
+        )
+        if self.seed_cfg:
+            self._seed_journal()
+        for i in range(self.n_replicas):
+            self.replicas.append(
+                ReplicaProcess(self._replica_config(i), self.base_dir,
+                               f"replica-{i}",
+                               self.replica_timeout_s).start()
+            )
+        from ..srv.router import ClusterRouter
+
+        self.router = ClusterRouter(
+            [r.addr for r in self.replicas], cfg=self.router_cfg,
+        ).start()
+        return self
+
+    def _seed_journal(self) -> None:
+        """Write the seed YAMLs into the broker's journaled CRUD topics
+        as ordinary Created frames (the same wire shape
+        store.ResourceService._emit produces) so every replica's boot
+        replay — not a per-process YAML load — installs the seed state."""
+        from ..srv.broker import SocketEventBus
+        from ..srv.worker import _yaml_list
+
+        kind_event = {"rule": "rule", "policy": "policy",
+                      "policy_set": "policySet"}
+        bus = SocketEventBus(self.broker_addr)
+        try:
+            for kind, key in (("rule", "rules"), ("policy", "policies"),
+                              ("policy_set", "policy_sets")):
+                path = self.seed_cfg.get(key)
+                if not path:
+                    continue
+                topic = bus.topic(f"io.restorecommerce.{kind}s.resource")
+                for doc in _yaml_list(path):
+                    topic.emit(
+                        f"{kind_event[kind]}Created",
+                        {"payload": doc, "origin": "cluster-seed"},
+                    )
+        finally:
+            bus.close()
+
+    def _replica_config(self, index: int) -> dict:
+        cfg: dict = {
+            "policies": {"type": "database"},
+            "events": {"broker": {"address": self.broker_addr}},
+        }
+        for key, value in self.cfg_extra.items():
+            if isinstance(value, dict) and isinstance(cfg.get(key), dict):
+                cfg[key] = {**cfg[key], **value}
+            else:
+                cfg[key] = value
+        return cfg
+
+    def restart_replica(self, index: int) -> ReplicaProcess:
+        """Boot a fresh process for a dead replica slot (same config dir:
+        the journal replay, not local state, restores its policy tree)
+        and swap its new address into the router."""
+        old = self.replicas[index]
+        replacement = ReplicaProcess(
+            self._replica_config(index), self.base_dir,
+            old.name, self.replica_timeout_s
+        ).start()
+        self.replicas[index] = replacement
+        if self.router is not None:
+            if old.addr:
+                self.router.remove_replica(old.addr)
+            self.router.add_replica(
+                replacement.addr, self.router_cfg.get("breaker") or {}
+            )
+        return replacement
+
+    def stop(self) -> None:
+        if self.router is not None:
+            self.router.stop()
+        for replica in self.replicas:
+            try:
+                replica.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.broker_proc is not None:
+            self.broker_proc.terminate()
+            try:
+                self.broker_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.broker_proc.kill()
+                self.broker_proc.wait(timeout=10)
+        if self._own_base:
+            shutil.rmtree(self.base_dir, ignore_errors=True)

@@ -332,6 +332,13 @@ class _Rpc:
             pass
 
 
+# subscription reconnect: jittered exponential backoff bounds (seconds).
+# The pump retries forever — a broker restart mid-deployment must never
+# silently end a replica's CRUD subscription (the policy-replication feed).
+RECONNECT_BACKOFF_MIN = 0.05
+RECONNECT_BACKOFF_MAX = 2.0
+
+
 class SocketTopic:
     """Topic interface (srv/events.py) backed by the broker."""
 
@@ -342,6 +349,7 @@ class SocketTopic:
         self._rpc = rpc
         self._secret = secret
         self._streams: list[socket.socket] = []
+        self._closed = threading.Event()
 
     @property
     def offset(self) -> int:
@@ -353,14 +361,9 @@ class SocketTopic:
              "event": event_name, "message": message}
         )["offset"]
 
-    def on(
-        self,
-        listener: Callable[[str, Any, dict], None],
-        starting_offset: Optional[int] = None,
-    ) -> None:
-        """Each listener gets its own streaming connection (replay from
-        ``starting_offset``, then live), dispatched from a daemon thread —
-        the Kafka-consumer analog of the in-process synchronous fanout."""
+    def _open_stream(self, from_offset: Optional[int]):
+        """One subscription connection: auth + subscribe handshake, returns
+        (socket, rfile).  Raises on any connection/auth failure."""
         host, port = self._address.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)))
         wfile = sock.makefile("wb")
@@ -372,21 +375,72 @@ class SocketTopic:
                 sock.close()
                 raise ConnectionError("broker auth failed for subscription")
         _send(wfile, {"op": "subscribe", "topic": self.name,
-                      "from": starting_offset})
+                      "from": from_offset})
+        return sock, rfile
+
+    def on(
+        self,
+        listener: Callable[[str, Any, dict], None],
+        starting_offset: Optional[int] = None,
+    ) -> None:
+        """Each listener gets its own streaming connection (replay from
+        ``starting_offset``, then live), dispatched from a daemon thread —
+        the Kafka-consumer analog of the in-process synchronous fanout.
+
+        The pump survives broker restarts: on a dropped connection it
+        reconnects with jittered exponential backoff and resubscribes from
+        the offset AFTER the last frame it delivered, so no acked frame is
+        redelivered and no frame emitted during the outage is lost (the
+        broker's journal preserves the log across restarts).  A listener
+        subscribed live-only (``starting_offset=None``) that has not yet
+        seen a frame resumes from the topic head at reconnect time."""
+        sock, rfile = self._open_stream(starting_offset)
         self._streams.append(sock)
+        # mutable last-delivered offset, shared with close(): -1 = nothing
+        # delivered yet
+        state = {"last": (starting_offset - 1
+                          if starting_offset is not None else -1)}
 
         def pump():
-            try:
-                for line in rfile:
-                    frame = json.loads(line)
-                    if "hb" in frame:  # stream liveness probe, not an event
+            import random as _random
+            import time as _time
+
+            nonlocal sock, rfile
+            backoff = RECONNECT_BACKOFF_MIN
+            while not self._closed.is_set():
+                try:
+                    for line in rfile:
+                        frame = json.loads(line)
+                        if "hb" in frame:  # liveness probe, not an event
+                            continue
+                        listener(
+                            frame["event"], frame["message"],
+                            {"offset": frame["offset"], "topic": self.name},
+                        )
+                        state["last"] = frame["offset"]
+                        backoff = RECONNECT_BACKOFF_MIN
+                    # EOF: broker closed the stream (restart/shutdown)
+                except (OSError, ValueError):
+                    pass
+                if self._closed.is_set():
+                    return
+                # reconnect loop: resume from the frame after the last
+                # delivered one (live-only streams that never saw a frame
+                # resume live — from=None)
+                while not self._closed.is_set():
+                    _time.sleep(backoff * (1.0 + _random.random()))
+                    backoff = min(backoff * 2.0, RECONNECT_BACKOFF_MAX)
+                    try:
+                        resume = (state["last"] + 1
+                                  if state["last"] >= 0 else starting_offset)
+                        new_sock, new_rfile = self._open_stream(resume)
+                    except (OSError, ConnectionError, ValueError):
                         continue
-                    listener(
-                        frame["event"], frame["message"],
-                        {"offset": frame["offset"], "topic": self.name},
-                    )
-            except (OSError, ValueError):
-                pass
+                    if sock in self._streams:
+                        self._streams.remove(sock)
+                    sock, rfile = new_sock, new_rfile
+                    self._streams.append(sock)
+                    break
 
         threading.Thread(target=pump, daemon=True).start()
 
@@ -397,7 +451,9 @@ class SocketTopic:
         return [(e, m) for e, m in events]
 
     def close(self) -> None:
-        for sock in self._streams:
+        # stop pumps from reconnecting before tearing their connections
+        self._closed.set()
+        for sock in list(self._streams):
             # shutdown, not just close: the pump thread's makefile objects
             # hold fd references (socket._io_refs), so close() alone never
             # tears the connection — the broker would keep heartbeating a

@@ -18,7 +18,7 @@ from .. import __version__
 class CommandInterface:
     def __init__(self, cfg, service, store=None, bus=None, cache=None,
                  decision_cache=None, admission=None, observability=None,
-                 logger=None):
+                 logger=None, worker=None):
         self.cfg = cfg
         self.service = service
         self.store = store
@@ -27,6 +27,7 @@ class CommandInterface:
         self.admission = admission
         self.observability = observability
         self.logger = logger
+        self.worker = worker  # cluster-tier surfaces (epoch, identity)
         self.api_key: Optional[str] = None
         self.start_time = time.time()
         if bus is not None:
@@ -63,6 +64,8 @@ class CommandInterface:
             "metrics": self.metrics,
             "traces": self.traces,
             "profile": self.profile,
+            "program_identity": self.program_identity,
+            "stage_stats": self.stage_stats,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -154,6 +157,13 @@ class CommandInterface:
                 # queue depths vs bounds, breaker states, latency
                 # estimates (srv/admission.py)
                 detail["admission"] = self.admission.stats()
+            if self.worker is not None and hasattr(
+                self.worker, "policy_epoch"
+            ):
+                # cluster tier: the replica's policy epoch (count of CRUD
+                # log frames reflected in the serving tree) — the router's
+                # per-replica convergence signal (srv/router.py)
+                detail["policy_epoch"] = self.worker.policy_epoch()
         except Exception as err:  # pragma: no cover
             healthy = False
             detail["error"] = str(err)
@@ -267,6 +277,36 @@ class CommandInterface:
             self._trace_dir = None
             return out
         return {"error": f"unknown profile action {action!r}"}
+
+    def program_identity(self, payload: dict) -> dict:
+        """Cluster-tier convergence probe: the replica's policy epoch plus
+        a digest of its compiled policy tables (srv/evaluator.py
+        table_fingerprint).  Two replicas that applied the same CRUD
+        sequence report identical fingerprints — the chaos harness and the
+        tpu_compat_audit ``cluster-replica-program-identity`` row compare
+        these across independently-patched processes."""
+        out: dict = {}
+        if self.worker is not None and hasattr(self.worker, "policy_epoch"):
+            out["policy_epoch"] = self.worker.policy_epoch()
+        if self.store is not None:
+            out["origin"] = self.store.origin
+        evaluator = self.service.evaluator
+        if evaluator is not None and hasattr(evaluator, "table_fingerprint"):
+            out["table_fingerprint"] = evaluator.table_fingerprint()
+        return out
+
+    def stage_stats(self, payload: dict) -> dict:
+        """Per-replica stage attribution for cluster benches: the stage
+        histograms from srv/tracing.py (count / totals / percentiles per
+        stage), optionally cleared first with ``{"clear": true}`` so a
+        timed window excludes warmup compiles."""
+        telemetry = getattr(self.service, "telemetry", None)
+        if telemetry is None:
+            return {"error": "telemetry not wired"}
+        if (payload or {}).get("clear"):
+            telemetry.stages.clear()
+            return {"status": "cleared"}
+        return {"stages": telemetry.snapshot().get("stages") or {}}
 
     def set_api_key(self, payload: dict) -> dict:
         self.api_key = (payload or {}).get("authentication", {}).get("apiKey") or (

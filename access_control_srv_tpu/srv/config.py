@@ -118,6 +118,53 @@ DEFAULT_CONFIG: dict = {
                          "port": 9464},
         "audit_log": {"path": None, "sample_rate": 0.01},
     },
+    # cluster tier (srv/router.py, parallel/cluster.py, docs/CLUSTER.md).
+    # Disabled by default: a single worker serves exactly as before.
+    # Enabled: N replica processes (each a full Worker against the shared
+    # broker, converging through the PolicyReplicator delta path) serve
+    # behind a ClusterRouter that load-balances unary calls and whole
+    # IsAllowedStream streams, retries shed/failed work on other replicas
+    # within the deadline budget, and tracks per-replica policy epochs.
+    # broker-backed policy replication (srv/store.PolicyReplicator).
+    # catchup_timeout_s bounds the boot-time gate: a (re)starting replica
+    # replays the journaled CRUD log and refuses to open its serving port
+    # until the tail observed at boot is reflected in its tree, so the
+    # router never routes to a half-replayed tree.
+    "replication": {
+        "enabled": True,
+        "catchup_timeout_s": 60.0,
+    },
+    "cluster": {
+        "enabled": False,
+        "replicas": 2,
+        # router placement + behavior
+        "router": {
+            "addr": "127.0.0.1:0",
+            # health/epoch poll cadence against each replica
+            "health_interval_s": 1.0,
+            # per-replica circuit breaker (reuses admission breakers'
+            # closed/open/half-open machine, srv/admission.py)
+            "breaker": {
+                "window_s": 5.0,
+                "min_volume": 4,
+                "failure_ratio": 0.5,
+                "open_s": 1.0,
+                "half_open_probes": 1,
+            },
+            # retry a shed/failed unary call on another replica only when
+            # this much of the deadline budget remains (fraction)
+            "retry_budget_fraction": 0.2,
+            "max_retries": 1,
+        },
+        # on-chip pods: jax.distributed.initialize per replica
+        # (parallel/cluster.py maybe_initialize_distributed); off for the
+        # CPU N-process tier
+        "distributed": {
+            "enabled": False,
+            "coordinator": "127.0.0.1:8476",
+            "num_processes": 1,
+        },
+    },
     "logger": {"maskFields": ["password", "token"]},
 }
 

@@ -320,6 +320,36 @@ class HybridEvaluator:
             out["capacities"] = caps.as_dict()
         return out
 
+    def table_fingerprint(self) -> Optional[str]:
+        """Digest of the compiled policy tables: every device array's
+        bytes + shape + dtype, the condition sources, the entity vocab and
+        the active capacities.  Two replicas that applied the same CRUD
+        sequence through the delta path hold byte-identical tables, so
+        their fingerprints match — the cluster tier's convergence check
+        (srv/router.py health, tests/test_cluster_chaos.py,
+        tpu_compat_audit cluster-replica-program-identity)."""
+        from hashlib import blake2b
+
+        compiled = self._compiled
+        if compiled is None:
+            return None
+        h = blake2b(digest_size=16)
+        for name in sorted(compiled.arrays):
+            arr = np.ascontiguousarray(compiled.arrays[name])
+            h.update(name.encode())
+            h.update(str(arr.dtype).encode())
+            h.update(repr(arr.shape).encode())
+            h.update(arr.tobytes())
+        h.update(repr(compiled.entity_vocab).encode())
+        h.update(repr([
+            (c.rule_flat_index, c.condition, repr(c.context_query), c.owner)
+            for c in compiled.conditions
+        ]).encode())
+        caps = self._caps
+        if caps is not None:
+            h.update(repr(sorted(caps.as_dict().items())).encode())
+        return h.hexdigest()
+
     # ------------------------------------------------------ full compile
 
     def _compile_worker(self) -> None:

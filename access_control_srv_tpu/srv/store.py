@@ -706,6 +706,15 @@ class PolicyReplicator:
         # remote mutations get the same delta patch + scoped invalidation
         # as local ones
         self._pending_events: list = []
+        # policy-epoch bookkeeping (cluster tier, srv/router.py): highest
+        # broker offset OBSERVED per CRUD topic, and the highest offset
+        # whose effect is REFLECTED in the serving tree (own-origin frames
+        # were applied at CRUD time; remote frames at the debounced sync).
+        # sum(applied+1) is the replica's policy epoch — the number every
+        # response is stamped with, so the router and the stale-decision
+        # oracle can compare replica states without reading the trees.
+        self.offsets: dict[str, int] = {}
+        self.applied_offsets: dict[str, int] = {}
         self._topics = {
             self.store.services[kind].topic.name: kind
             for kind in ("rule", "policy", "policy_set")
@@ -717,6 +726,34 @@ class PolicyReplicator:
             self.bus.topic(topic_name).on(self._on_event, starting_offset=0)
         return self
 
+    @property
+    def epoch(self) -> int:
+        """Policy epoch: count of CRUD log frames reflected in the serving
+        tree (sum of applied offsets + 1 across the CRUD topics)."""
+        with self._lock:
+            return sum(off + 1 for off in self.applied_offsets.values())
+
+    def _mark_applied(self, topic: str, offset: int) -> None:
+        """A frame whose effect is already in the tree (own-origin, no-op,
+        malformed-and-quarantined): advance the applied watermark when no
+        remote frames are pending, so the mutating replica's epoch covers
+        its own CRUD immediately rather than at the next debounced sync."""
+        with self._lock:
+            if self._pending_events:
+                # remote frames are awaiting the debounced sync: this
+                # frame's effect is in the tree, but claiming it applied
+                # now would overclaim any pending lower-offset frame on
+                # the same topic — record it for the armed sync (which
+                # snapshots self.offsets) to advance instead of dropping
+                # it from the watermark entirely
+                self.offsets[topic] = max(
+                    self.offsets.get(topic, -1), offset
+                )
+            else:
+                self.applied_offsets[topic] = max(
+                    self.applied_offsets.get(topic, -1), offset
+                )
+
     def _on_event(self, event_name: str, message, ctx: dict) -> None:
         if self._stopped:
             return
@@ -724,10 +761,16 @@ class PolicyReplicator:
         kind = self._topics.get(topic)
         if kind is None or not isinstance(message, dict):
             return
+        offset = ctx.get("offset")
+        offset = offset if isinstance(offset, int) else -1
         if message.get("origin") == self.store.origin:
+            if offset >= 0:
+                self._mark_applied(topic, offset)
             return  # our own mutation, already applied + synced
         doc = message.get("payload")
         if not isinstance(doc, dict):
+            if offset >= 0:
+                self._mark_applied(topic, offset)
             return
         collection = self.store.collections[kind]
         try:
@@ -757,6 +800,8 @@ class PolicyReplicator:
                     )
                     collection.delete(doc["id"])
             else:
+                if offset >= 0:
+                    self._mark_applied(topic, offset)
                 return
         except Exception:  # noqa: BLE001 — a bad frame must not kill the pump
             if self.logger:
@@ -764,11 +809,13 @@ class PolicyReplicator:
                     "replication apply failed",
                     extra={"topic": topic, "event": event_name},
                 )
+            if offset >= 0:
+                self._mark_applied(topic, offset)  # quarantined, not pending
             return
         self._applied += 1
-        self._schedule_sync(event)
+        self._schedule_sync(event, topic=topic, offset=offset)
 
-    def _schedule_sync(self, event=None) -> None:
+    def _schedule_sync(self, event=None, topic=None, offset=-1) -> None:
         # arm only when no sync is pending: the pending sync composes
         # from the live collections at fire time, so it covers every
         # frame applied before it runs — and a replay burst of N frames
@@ -776,6 +823,13 @@ class PolicyReplicator:
         with self._lock:
             if event is not None:
                 self._pending_events.append(event)
+            if topic is not None and offset >= 0:
+                # recorded under the same lock as the pending append so a
+                # concurrent _sync snapshot never advances the epoch past
+                # an event it did not apply
+                self.offsets[topic] = max(
+                    self.offsets.get(topic, -1), offset
+                )
             if self._stopped or self._timer is not None:
                 return
             self._timer = threading.Timer(self.debounce_s, self._sync)
@@ -787,11 +841,42 @@ class PolicyReplicator:
             self._timer = None
             events = self._pending_events
             self._pending_events = []
+            observed = dict(self.offsets)
         try:
             self.store.load(events or None)
         except Exception:  # noqa: BLE001
             if self.logger:
                 self.logger.exception("replication tree sync failed")
+        else:
+            # every frame observed before this sync started is now
+            # reflected in the tree: advance the epoch watermark
+            with self._lock:
+                for topic, off in observed.items():
+                    self.applied_offsets[topic] = max(
+                        self.applied_offsets.get(topic, -1), off
+                    )
+
+    def wait_caught_up(self, timeout_s: float = 60.0) -> bool:
+        """Block until every CRUD frame journaled at call time is
+        reflected in the serving tree (epoch >= journal tail).  A
+        rebooting replica calls this before opening its serving port —
+        answering from a half-replayed tree would hand the router
+        INDETERMINATE decisions stamped with a stale epoch.  Returns
+        False on timeout or if the journal tail is unreadable (the
+        caller serves anyway, degraded, rather than hanging boot)."""
+        try:
+            total = sum(
+                len(self.bus.topic(name).read(0))
+                for name in self._topics
+            )
+        except Exception:  # noqa: BLE001 — broker gone: nothing to wait on
+            return False
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.epoch >= total:
+                return True
+            time.sleep(0.02)
+        return False
 
     def stop(self) -> None:
         with self._lock:

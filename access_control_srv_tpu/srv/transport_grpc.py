@@ -35,7 +35,7 @@ from .tracing import (
     STAGE_ORACLE,
     STAGE_SERIALIZE,
     STAGE_TRANSPORT_PARSE,
-    echo_trace_id,
+    TRACE_ID_METADATA_KEY,
     trace_id_from_metadata,
 )
 
@@ -433,6 +433,41 @@ def resolve_fallback_rows(worker, responses: list, fallback_rows: list,
         responses[b] = response_to_pb(resp)
 
 
+POLICY_EPOCH_METADATA_KEY = "x-acs-policy-epoch"
+SHED_METADATA_KEY = "x-acs-shed"
+# admission-control shed statuses (srv/admission.py): 429 queue-full,
+# 503 breaker-open, 504 deadline-infeasible
+SHED_CODES = frozenset((429, 503, 504))
+
+
+def stamp_trailers(context, worker, trace_id=None, shed=False):
+    """Set the response's trailing metadata in ONE call (grpc's
+    set_trailing_metadata overwrites, so every stamp merges here):
+    ``x-acs-policy-epoch`` — the replica's policy epoch, letting the
+    cluster router (srv/router.py) track per-replica convergence from
+    live traffic without polling; ``x-acs-shed`` — the whole request
+    was shed by admission control, so the router may retry it on
+    another replica without parsing response bytes; plus the trace-id
+    echo (srv/tracing.py) when the request was sampled."""
+    md = []
+    epoch_fn = getattr(worker, "policy_epoch", None)
+    if epoch_fn is not None:
+        try:
+            md.append((POLICY_EPOCH_METADATA_KEY, str(epoch_fn())))
+        except Exception:  # noqa: BLE001 — stamping never fails a request
+            pass
+    if shed:
+        md.append((SHED_METADATA_KEY, "1"))
+    if trace_id:
+        md.append((TRACE_ID_METADATA_KEY, trace_id))
+    if not md:
+        return
+    try:
+        context.set_trailing_metadata(tuple(md))
+    except Exception:  # noqa: BLE001 — non-grpc test doubles
+        pass
+
+
 def _unary(handler, req_cls, resp_cls):
     return grpc.unary_unary_rpc_method_handler(
         handler,
@@ -479,6 +514,10 @@ class GrpcServer:
                     request_from_pb(request),
                     deadline=deadline_from_context(context),
                 )
+                stamp_trailers(
+                    context, worker,
+                    shed=response.operation_status.code in SHED_CODES,
+                )
                 return response_to_pb(response)
             # traced path: span at transport receive (trace id from the
             # x-acs-trace-id metadata key — an explicit id forces
@@ -500,8 +539,12 @@ class GrpcServer:
             msg = response_to_pb(response)
             tracer.record(span, STAGE_SERIALIZE,
                           time.perf_counter() - t_ser)
+            stamp_trailers(
+                context, worker,
+                trace_id=span.trace_id if span is not None else None,
+                shed=response.operation_status.code in SHED_CODES,
+            )
             if span is not None:
-                echo_trace_id(context, span.trace_id)
                 tracer.finish(span, decision=response.decision,
                               code=response.operation_status.code)
             return msg
@@ -527,9 +570,20 @@ class GrpcServer:
                 tracer.record(span, STAGE_TRANSPORT_PARSE, now - t_stage)
                 t_stage = now
 
-            def finish_rpc(payload: bytes) -> bytes:
+            def _shed_all(resps) -> bool:
+                # whole-batch shed (every row an admission status):
+                # stamped so the router may retry the batch elsewhere
+                return bool(resps) and all(
+                    r.operation_status.code in SHED_CODES for r in resps
+                )
+
+            def finish_rpc(payload: bytes, shed: bool = False) -> bytes:
+                stamp_trailers(
+                    context, worker,
+                    trace_id=span.trace_id if span is not None else None,
+                    shed=shed,
+                )
                 if tracer is not None and span is not None:
-                    echo_trace_id(context, span.trace_id)
                     tracer.finish(span, code=200)
                 return payload
 
@@ -570,12 +624,14 @@ class GrpcServer:
                                 PB_TO_DECISION.get(resp.decision, "DENY")
                             )
                     if tracer is None:
+                        stamp_trailers(context, worker,
+                                       shed=_shed_all(responses))
                         return serialize_batch_response(responses)
                     t_stage = _time.perf_counter()
                     payload = serialize_batch_response(responses)
                     tracer.record(span, STAGE_SERIALIZE,
                                   _time.perf_counter() - t_stage)
-                    return finish_rpc(payload)
+                    return finish_rpc(payload, shed=_shed_all(responses))
             if tracer is not None:
                 t_stage = _time.perf_counter()
             request = pb.BatchRequest.FromString(raw)
@@ -591,6 +647,7 @@ class GrpcServer:
                 reqs, deadline=deadline,
             )
             if tracer is None:
+                stamp_trailers(context, worker, shed=_shed_all(responses))
                 return serialize_batch_response(
                     [response_to_pb(r) for r in responses]
                 )
@@ -600,7 +657,7 @@ class GrpcServer:
             )
             tracer.record(span, STAGE_SERIALIZE,
                           _time.perf_counter() - t_stage)
-            return finish_rpc(payload)
+            return finish_rpc(payload, shed=_shed_all(responses))
 
         def is_allowed_stream(request_iterator, context):
             """Streaming batch endpoint: a stream of BatchRequest
@@ -622,6 +679,7 @@ class GrpcServer:
             if pipeline is None:
                 for raw in request_iterator:
                     yield is_allowed_batch(raw, context)
+                stamp_trailers(context, worker)
                 return
             frames: "_queue.Queue" = _queue.Queue()
 
@@ -653,6 +711,10 @@ class GrpcServer:
                 if tracer is not None and span is not None:
                     tracer.finish(span, code=200)
                 yield payload
+            # stream-level trailer: the epoch as of stream completion
+            # (per-frame epochs would need in-band stamping; the router
+            # refreshes epochs from unary traffic and health polls)
+            stamp_trailers(context, worker)
 
         def what_is_allowed(request, context):
             rq = worker.service.what_is_allowed(

@@ -6,6 +6,7 @@ interface and event listeners into a running service
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from ..core.engine import AccessController
@@ -61,6 +62,11 @@ class Worker:
         self.logger = None
         self.mesh = None
         self.obs = None  # srv/tracing.Observability (None = disabled)
+        self.replicator = None
+        # live CRUD-offset watermark per topic (policy_epoch fallback for
+        # workers without a replicator)
+        self._epoch_lock = threading.Lock()
+        self._crud_offsets: dict = {}
 
     def start(
         self,
@@ -328,6 +334,7 @@ class Worker:
             admission=self.admission,
             observability=self.obs,
             logger=self.logger,
+            worker=self,
         )
         self.batcher = MicroBatcher(
             self.evaluator,
@@ -356,13 +363,10 @@ class Worker:
         self.bus.topic("io.restorecommerce.users.resource").on(
             self._user_listener
         )
-        if self.decision_cache is not None:
-            # CRUD frames flush cached decisions the moment they land —
-            # including REMOTE workers' frames, which otherwise only take
-            # effect at the replicator's debounced tree sync (local
-            # mutations bump again through store hot-sync; double bumps
-            # are harmless)
-            on_topics(self.bus, CRUD_TOPICS, self._crud_cache_listener)
+        # always subscribed (not only with a decision cache): the listener
+        # also maintains the live CRUD-offset watermark behind
+        # policy_epoch() for workers running without a replicator
+        on_topics(self.bus, CRUD_TOPICS, self._crud_cache_listener)
 
         # seed data (reference: src/worker.ts:200-242)
         seed_cfg = cfg.get("seed_data")
@@ -390,6 +394,16 @@ class Worker:
             self.replicator = PolicyReplicator(
                 self.store, self.bus, logger=self.logger
             ).start()
+            # boot-time catch-up gate: don't return (and so don't let the
+            # CLI open the serving port) until the journal tail observed
+            # at boot is reflected in the tree — a half-replayed replica
+            # would answer INDETERMINATE and the cluster router would
+            # happily route to it (tests/test_cluster_chaos.py)
+            self.replicator.wait_caught_up(
+                timeout_s=float(
+                    cfg.get("replication:catchup_timeout_s", 60.0)
+                )
+            )
         return self
 
     def stop(self) -> None:
@@ -439,7 +453,18 @@ class Worker:
         cached decisions suspect before the replicator's debounced sync
         lands).  This worker's OWN frames are skipped: the local CRUD path
         already bumped through store hot-sync — with a delta-scoped
-        footprint, which an unconditional global bump here would defeat."""
+        footprint, which an unconditional global bump here would defeat.
+
+        All frames (own included) advance the live CRUD-offset watermark
+        behind policy_epoch() — the fallback epoch source when no
+        replicator is running."""
+        offset = ctx.get("offset")
+        topic = ctx.get("topic")
+        if isinstance(offset, int) and topic:
+            with self._epoch_lock:
+                self._crud_offsets[topic] = max(
+                    self._crud_offsets.get(topic, -1), offset
+                )
         if not event_name.endswith(("Created", "Modified", "Deleted")):
             return
         if (
@@ -448,7 +473,22 @@ class Worker:
             and message.get("origin") == self.store.origin
         ):
             return
-        self.decision_cache.bump_epoch()
+        if self.decision_cache is not None:
+            self.decision_cache.bump_epoch()
+
+    def policy_epoch(self) -> int:
+        """The replica's policy epoch: number of CRUD log frames reflected
+        in the serving tree.  Replicated workers read the replicator's
+        applied watermark (replay-inclusive, so replicas that booted at
+        different times agree once converged); standalone workers count the
+        frames the live listener has seen.  Responses are stamped with this
+        value (transport_grpc) so the cluster router and the stale-decision
+        oracle can reason about replica state without reading the trees."""
+        replicator = getattr(self, "replicator", None)
+        if replicator is not None:
+            return replicator.epoch
+        with self._epoch_lock:
+            return sum(off + 1 for off in self._crud_offsets.values())
 
     def _user_listener(self, event_name: str, message, ctx: dict) -> None:
         """userModified / userDeleted -> subject-cache + decision-cache

@@ -1,0 +1,164 @@
+"""Shared helpers for the cluster-tier test suites (test_router.py,
+test_cluster_chaos.py): wire-level request builders, CRUD-over-gRPC
+helpers and convergence polling against live replica processes."""
+
+import json
+import os
+import time
+
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+from .utils import URNS
+
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+READ = URNS["read"]
+PO = ("urn:oasis:names:tc:xacml:3.0:rule-combining-algorithm:"
+      "permit-overrides")
+SEED_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data", "seed_data",
+)
+
+
+def seed_paths() -> dict:
+    return {
+        "policy_sets": os.path.join(SEED_DIR, "policy_sets.yaml"),
+        "policies": os.path.join(SEED_DIR, "policies.yaml"),
+        "rules": os.path.join(SEED_DIR, "rules.yaml"),
+    }
+
+
+def wire_request(role="superadministrator-r-id", resource_id="O1"):
+    """pb.Request for ORG read with the given role (the
+    tests/test_grpc_transport.py wire shape)."""
+    msg = pb.Request()
+    msg.target.subjects.add(id=URNS["role"], value=role)
+    msg.target.subjects.add(id=URNS["subjectID"], value="root")
+    msg.target.resources.add(id=URNS["entity"], value=ORG)
+    msg.target.resources.add(id=URNS["resourceID"], value=resource_id)
+    msg.target.actions.add(id=URNS["actionID"], value=READ)
+    msg.context.subject.value = json.dumps({
+        "id": "root",
+        "role_associations": [{"role": role, "attributes": []}],
+        "hierarchical_scopes": [],
+    }).encode()
+    entry = msg.context.resources.add()
+    entry.value = json.dumps(
+        {"id": resource_id, "meta": {"owners": []}}
+    ).encode()
+    return msg
+
+
+def reader_rule_doc(rid="r_cluster", role="reader-role", effect="PERMIT"):
+    return {
+        "id": rid,
+        "name": rid,
+        "target": {
+            "subjects": [{"id": URNS["role"], "value": role}],
+            "resources": [{"id": URNS["entity"], "value": ORG}],
+            "actions": [{"id": URNS["actionID"], "value": READ}],
+        },
+        "effect": effect,
+    }
+
+
+def _fill_attr(msg, doc):
+    msg.id = doc.get("id") or ""
+    msg.value = str(doc.get("value") or "")
+    for child in doc.get("attributes") or []:
+        _fill_attr(msg.attributes.add(), child)
+
+
+def rule_to_pb(doc: dict) -> pb.Rule:
+    msg = pb.Rule()
+    msg.id = doc["id"]
+    msg.name = doc.get("name") or ""
+    msg.effect = doc.get("effect") or ""
+    target = doc.get("target") or {}
+    for field in ("subjects", "resources", "actions"):
+        for attr in target.get(field) or []:
+            _fill_attr(getattr(msg.target, field).add(), attr)
+    return msg
+
+
+def crud_fn(channel, service: str, method: str, resp_cls):
+    return channel.unary_unary(
+        f"/acstpu.{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+def upsert_rule(channel, doc: dict) -> int:
+    fn = crud_fn(channel, "RuleService", "Upsert", pb.MutationResponse)
+    rl = pb.RuleList(items=[rule_to_pb(doc)])
+    rl.subject.id = "root"
+    return fn(rl).operation_status.code
+
+
+def create_reader_policy_tree(channel, rid="r_cluster") -> None:
+    """Rule + policy + policy set for the reader role, via the router's
+    CRUD surface (so the frames land in the cluster journal)."""
+    assert upsert_rule(channel, reader_rule_doc(rid)) == 200
+    pol = pb.PolicyList()
+    item = pol.items.add()
+    item.id = f"p_{rid}"
+    item.combining_algorithm = PO
+    item.rules.append(rid)
+    pol.subject.id = "root"
+    assert crud_fn(channel, "PolicyService", "Upsert",
+                   pb.MutationResponse)(pol).operation_status.code == 200
+    pset = pb.PolicySetList()
+    item = pset.items.add()
+    item.id = f"ps_{rid}"
+    item.combining_algorithm = PO
+    item.policies.append(f"p_{rid}")
+    pset.subject.id = "root"
+    assert crud_fn(channel, "PolicySetService", "Upsert",
+                   pb.MutationResponse)(pset).operation_status.code == 200
+
+
+def command_over(channel, name: str, payload: dict | None = None) -> dict:
+    fn = channel.unary_unary(
+        "/acstpu.CommandInterface/Command",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=pb.CommandResponse.FromString,
+    )
+    request = pb.CommandRequest(name=name)
+    if payload is not None:
+        request.payload = json.dumps(payload).encode()
+    resp = fn(request)
+    return json.loads(resp.payload or b"{}")
+
+
+def program_identities(addrs, timeout_s=5.0) -> list[dict]:
+    import grpc
+
+    out = []
+    for addr in addrs:
+        channel = grpc.insecure_channel(addr)
+        try:
+            out.append(command_over(channel, "program_identity"))
+        finally:
+            channel.close()
+    return out
+
+
+def wait_converged(addrs, timeout_s=30.0, min_epoch=0) -> list[dict]:
+    """Poll program_identity on every replica until all report one
+    (epoch, fingerprint) pair with epoch >= min_epoch; returns the final
+    identity list (asserting convergence)."""
+    deadline = time.monotonic() + timeout_s
+    ids: list[dict] = []
+    while time.monotonic() < deadline:
+        ids = program_identities(addrs)
+        pairs = {
+            (i.get("policy_epoch"), i.get("table_fingerprint"))
+            for i in ids
+        }
+        if len(pairs) == 1:
+            epoch, fingerprint = next(iter(pairs))
+            if fingerprint is not None and (epoch or 0) >= min_epoch:
+                return ids
+        time.sleep(0.2)
+    raise AssertionError(f"replicas did not converge: {ids}")

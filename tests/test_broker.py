@@ -557,3 +557,67 @@ def test_late_worker_replays_crud_log(broker):
             worker_b.stop()
     finally:
         worker_a.stop()
+
+
+def _free_port() -> int:
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_broker(port: int, data_dir: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "access_control_srv_tpu", "--broker",
+         "--addr", f"127.0.0.1:{port}", "--broker-data-dir", data_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()  # "broker listening on ..."
+    assert "listening" in line, line
+    return proc
+
+
+def test_subscription_survives_broker_restart(tmp_path):
+    """Regression (PR 9 satellite): a dropped subscription connection must
+    not silently end a listener's feed.  The pump reconnects with jittered
+    backoff and resubscribes from the offset after the last frame it
+    delivered — frames emitted while the broker was down (journal-durable
+    log) and frames emitted after the restart all arrive, exactly once."""
+    data_dir = str(tmp_path / "reconnect-data")
+    port = _free_port()
+    proc = _spawn_broker(port, data_dir)
+    bus = SocketEventBus(f"127.0.0.1:{port}")
+    topic = bus.topic("reconnect.topic")
+    got = []
+    topic.on(lambda e, m, ctx: got.append((m["i"], ctx["offset"])),
+             starting_offset=0)
+    try:
+        topic.emit("thing", {"i": 0})
+        deadline = time.time() + 5
+        while time.time() < deadline and len(got) < 1:
+            time.sleep(0.02)
+        assert got == [(0, 0)]
+
+        # broker process dies mid-subscription ...
+        proc.kill()
+        proc.wait(timeout=10)
+        time.sleep(0.2)
+        # ... and restarts on the same port + journal; frames emitted
+        # after the restart continue the offset sequence
+        proc = _spawn_broker(port, data_dir)
+        emitter = SocketEventBus(f"127.0.0.1:{port}")
+        emitter.topic("reconnect.topic").emit("thing", {"i": 1})
+        emitter.topic("reconnect.topic").emit("thing", {"i": 2})
+        deadline = time.time() + 15
+        while time.time() < deadline and len(got) < 3:
+            time.sleep(0.05)
+        assert got == [(0, 0), (1, 1), (2, 2)]  # no loss, no redelivery
+        emitter.close()
+    finally:
+        bus.close()
+        proc.kill()
+        proc.wait(timeout=10)

@@ -1,0 +1,240 @@
+"""Cluster chaos: kill -9 one of two replicas mid-CRUD-churn with a
+closed-loop client running through the router.
+
+Acceptance (ISSUE PR 9): zero failed client requests beyond honest shed
+statuses, the restarted replica converges byte-identically (same policy
+epoch + table fingerprint as the survivor), and a stale-decision oracle
+finds zero stale decisions — every response's decision matches the
+policy state its stamped epoch claims, so the decision cache's
+cluster-wide scoped invalidation provably works under churn.
+
+The oracle is journal-exact: after the run it reads the broker's rules
+topic, so it knows EXACTLY which effect the chaos rule had after k
+applied rule frames.  A response stamped with epoch e was served from a
+tree reflecting e CRUD frames; its decision must match the effect at
+that journal position (with one-frame tolerance when a flip was in
+flight during the request — the stamp is read after evaluation, so a
+concurrent apply can advance it by one)."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from access_control_srv_tpu.parallel.cluster import LocalCluster
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.router import POLICY_EPOCH_METADATA_KEY
+
+from .cluster_util import (
+    create_reader_policy_tree,
+    reader_rule_doc,
+    seed_paths,
+    upsert_rule,
+    wait_converged,
+    wire_request,
+)
+
+SHED_CODES = (429, 503, 504)
+RULE_ID = "r_chaos"
+
+
+@pytest.mark.cluster(timeout=240)
+def test_kill9_replica_mid_crud_churn(tmp_path):
+    cluster = LocalCluster(
+        n_replicas=2,
+        seed_cfg=seed_paths(),
+        router_cfg={"health_interval_s": 0.3, "max_retries": 1},
+        base_dir=str(tmp_path),
+    ).start()
+    channel = grpc.insecure_channel(cluster.router.addr)
+    try:
+        create_reader_policy_tree(channel, RULE_ID)
+        addrs = [r.addr for r in cluster.replicas]
+        wait_converged(addrs, timeout_s=30.0, min_epoch=1)
+
+        is_allowed = channel.unary_unary(
+            "/acstpu.AccessControlService/IsAllowed",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.Response.FromString,
+        )
+        stop = threading.Event()
+        records: list = []   # (t_send, t_recv, code, decision, epoch)
+        transport_errors: list = []
+
+        def client_loop():
+            msg = wire_request(role="reader-role")
+            while not stop.is_set():
+                t_send = time.monotonic()
+                try:
+                    resp, call = is_allowed.with_call(msg, timeout=10)
+                except grpc.RpcError as err:
+                    transport_errors.append(
+                        (time.monotonic(), err.code(), err.details())
+                    )
+                    time.sleep(0.02)
+                    continue
+                trailers = dict(call.trailing_metadata() or ())
+                records.append((
+                    t_send,
+                    time.monotonic(),
+                    resp.operation_status.code,
+                    resp.decision,
+                    int(trailers.get(POLICY_EPOCH_METADATA_KEY, -1)),
+                ))
+                time.sleep(0.004)
+
+        flip_acks: list = []  # (t_before_send, t_ack)
+        state = {"effect": "PERMIT"}
+
+        def churn_loop():
+            while not stop.is_set():
+                effect = "DENY" if state["effect"] == "PERMIT" \
+                    else "PERMIT"
+                t_before = time.monotonic()
+                try:
+                    code = upsert_rule(
+                        channel, reader_rule_doc(RULE_ID, effect=effect)
+                    )
+                except grpc.RpcError:
+                    time.sleep(0.05)
+                    continue
+                if code == 200:
+                    flip_acks.append((t_before, time.monotonic()))
+                    state["effect"] = effect
+                time.sleep(0.12)
+
+        client = threading.Thread(target=client_loop, daemon=True)
+        churn = threading.Thread(target=churn_loop, daemon=True)
+        client.start()
+        churn.start()
+
+        time.sleep(1.5)                    # steady churn, both replicas
+        victim = cluster.replicas[1]
+        victim.kill()                      # SIGKILL mid-churn
+        time.sleep(2.5)                    # churn + serving on survivor
+        restarted = cluster.restart_replica(1)
+        # restarted replica must converge byte-identically with the
+        # survivor (journal replay through the delta path)
+        ids = wait_converged(
+            [cluster.replicas[0].addr, restarted.addr], timeout_s=60.0,
+        )
+        time.sleep(1.0)                    # traffic lands on both again
+        stop.set()
+        client.join(timeout=15)
+        churn.join(timeout=15)
+        assert not client.is_alive() and not churn.is_alive()
+
+        # ---- acceptance 1: no failed requests beyond honest sheds ----
+        assert not transport_errors, transport_errors[:5]
+        bad_codes = {
+            code for _, _, code, _, _ in records
+            if code != 200 and code not in SHED_CODES
+        }
+        assert not bad_codes, bad_codes
+        assert len(records) > 100  # the loop really ran through the kill
+
+        # ---- acceptance 2: byte-identical convergence -----------------
+        assert len({
+            (i["policy_epoch"], i["table_fingerprint"]) for i in ids
+        }) == 1, ids
+        assert ids[0]["table_fingerprint"] is not None
+
+        # ---- acceptance 3: journal-exact stale-decision oracle --------
+        from access_control_srv_tpu.srv.broker import SocketEventBus
+
+        bus = SocketEventBus(cluster.broker_addr)
+        try:
+            rule_frames = bus.topic(
+                "io.restorecommerce.rules.resource"
+            ).read(0)
+            # store.py topic scheme: io.restorecommerce.{kind}s.resource
+            other = sum(
+                len(bus.topic(
+                    f"io.restorecommerce.{kind}s.resource"
+                ).read(0))
+                for kind in ("policy", "policy_set")
+            )
+        finally:
+            bus.close()
+        # effect of the chaos rule after k applied rule frames
+        effect_at: list = []
+        current = None
+        for _event, message in rule_frames:
+            doc = (message or {}).get("payload") or {}
+            if doc.get("id") == RULE_ID:
+                current = doc.get("effect")
+            effect_at.append(current)
+        expected_decision = {
+            "PERMIT": pb.PERMIT, "DENY": pb.DENY, None: None,
+        }
+
+        def ok_at(epoch: int, decision) -> bool:
+            k = epoch - other  # rule frames applied at this epoch
+            if k < 1 or k > len(effect_at):
+                return False
+            want = expected_decision[effect_at[k - 1]]
+            return want is not None and decision == want
+
+        stale = []
+        for t_send, t_recv, code, decision, epoch in records:
+            if code != 200:
+                continue  # honest shed: INDETERMINATE, not a decision
+            assert epoch >= 0, "decision response missing epoch stamp"
+            if ok_at(epoch, decision):
+                continue
+            # one-frame tolerance only while a flip was near in flight
+            # (replica apply lags the CRUD ack by the replicator
+            # debounce; a truly stale cache entry misses by many frames)
+            in_flight = any(
+                t_before <= t_recv + 0.25 and t_ack >= t_send - 1.0
+                for t_before, t_ack in flip_acks
+            )
+            if in_flight and (
+                ok_at(epoch - 1, decision) or ok_at(epoch + 1, decision)
+            ):
+                continue
+            stale.append((t_send, code, decision, epoch))
+        assert not stale, (
+            f"{len(stale)} stale decisions, e.g. {stale[:5]}; "
+            f"{len(rule_frames)} rule frames, other={other}"
+        )
+        assert len(flip_acks) >= 5  # churn actually churned
+    finally:
+        channel.close()
+        cluster.stop()
+
+
+@pytest.mark.cluster(timeout=180)
+def test_restarted_replica_serves_correct_decisions(tmp_path):
+    """A killed+restarted replica must serve the post-churn policy state
+    directly (not only report matching fingerprints): flip the chaos
+    rule to DENY while the replica is down, restart, and ask IT."""
+    cluster = LocalCluster(
+        n_replicas=2, seed_cfg=seed_paths(), base_dir=str(tmp_path),
+        router_cfg={"health_interval_s": 0.3},
+    ).start()
+    channel = grpc.insecure_channel(cluster.router.addr)
+    try:
+        create_reader_policy_tree(channel, RULE_ID)
+        wait_converged([r.addr for r in cluster.replicas], timeout_s=30.0)
+        cluster.replicas[1].kill()
+        assert upsert_rule(
+            channel, reader_rule_doc(RULE_ID, effect="DENY")
+        ) == 200
+        restarted = cluster.restart_replica(1)
+        wait_converged(
+            [cluster.replicas[0].addr, restarted.addr], timeout_s=60.0,
+        )
+        from access_control_srv_tpu.srv.transport_grpc import GrpcClient
+
+        direct = GrpcClient(restarted.addr)
+        try:
+            resp = direct.is_allowed(wire_request(role="reader-role"))
+            assert resp.operation_status.code == 200
+            assert resp.decision == pb.DENY
+        finally:
+            direct.close()
+    finally:
+        channel.close()
+        cluster.stop()

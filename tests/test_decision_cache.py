@@ -199,6 +199,58 @@ class TestRequestFingerprint:
         assert request_fingerprint(Request(target=None, context={})) is None
 
 
+class TestFingerprintSingleComputation:
+    """The fingerprint digest is memoized on the request object
+    (``_dc_key``): however many layers consult the cache — batcher fast
+    path, evaluator single path, batch keying — one request pays for
+    exactly one blake2b computation."""
+
+    @pytest.fixture()
+    def digest_counter(self, monkeypatch):
+        from access_control_srv_tpu.srv import decision_cache as dc
+
+        calls = {"n": 0}
+        real = dc.blake2b
+
+        def counting(*args, **kwargs):
+            calls["n"] += 1
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(dc, "blake2b", counting)
+        return calls
+
+    def test_unit_repeat_fingerprint_is_free(self, digest_counter):
+        request = build_request(subject_id="u1", subject_role="r1",
+                                resource_type=ORG, resource_id="O1",
+                                action_type=READ)
+        key = request_fingerprint(request)
+        assert key is not None and digest_counter["n"] == 1
+        assert request_fingerprint(request) == key
+        assert digest_counter["n"] == 1  # memoized, not recomputed
+
+    def test_serving_path_computes_fingerprint_once(self, worker,
+                                                    digest_counter):
+        request = admin_request()
+        assert worker.service.is_allowed(request).decision == Decision.PERMIT
+        assert digest_counter["n"] == 1  # cold miss: one digest total
+        # the same object resubmitted rides its memo through every layer
+        assert worker.service.is_allowed(request).decision == Decision.PERMIT
+        assert digest_counter["n"] == 1
+        # a fresh equivalent request pays one digest for its cache hit
+        digest_counter["n"] = 0
+        assert worker.service.is_allowed(
+            admin_request()
+        ).decision == Decision.PERMIT
+        assert digest_counter["n"] == 1
+
+    def test_batch_path_computes_one_fingerprint_per_request(
+            self, worker, digest_counter):
+        requests = [admin_request() for _ in range(4)]
+        responses = worker.service.is_allowed_batch(requests)
+        assert all(r.decision == Decision.PERMIT for r in responses)
+        assert digest_counter["n"] == len(requests)
+
+
 # ---------------------------------------------------------------- worker
 
 

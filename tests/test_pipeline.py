@@ -442,6 +442,65 @@ class TestStreamingOrdering:
             server.stop()
             worker.stop()
 
+    def test_aborted_streams_release_permits_and_leases(self):
+        """Client disconnect mid-IsAllowedStream: after N aborted streams
+        (each cancelled right after its first response frame, with more
+        frames still queued against the pipeline's backpressure), every
+        backpressure permit must be reacquirable and the pooled staging
+        buffers must show zero live leases — a leak here would brick the
+        shared pipeline for every later stream."""
+        from access_control_srv_tpu.ops.staging import default_pool
+        from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+        worker, server, client = self._worker(depth=2)
+        try:
+            stub = client.channel.stream_stream(
+                "/acstpu.AccessControlService/IsAllowedStream",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.BatchResponse.FromString,
+            )
+            frame = self._frames([8])[0]
+
+            def endless():
+                while True:  # keeps feeding until the cancel lands
+                    yield frame
+
+            for _ in range(6):
+                call = stub(endless(), timeout=30)
+                first = next(call)
+                assert len(first.responses) == 8
+                call.cancel()
+
+            pipeline = worker.wire_pipeline
+            deadline = time.monotonic() + 15
+
+            def permits_free() -> int:
+                held = 0
+                for _ in range(pipeline.depth):
+                    if pipeline._slots.acquire(blocking=False):
+                        held += 1
+                for _ in range(held):
+                    pipeline._slots.release()
+                return held
+
+            while time.monotonic() < deadline:
+                if (permits_free() == pipeline.depth
+                        and default_pool().stats()["leased"] == 0):
+                    break
+                time.sleep(0.05)
+            assert permits_free() == pipeline.depth
+            assert default_pool().stats()["leased"] == 0
+            # the pipeline still serves a fresh, well-behaved stream
+            sizes = [8, 12]
+            responses = list(client.is_allowed_stream(
+                iter(self._frames(sizes)), timeout=60
+            ))
+            assert [len(r.responses) for r in responses] == sizes
+        finally:
+            client.close()
+            server.stop()
+            worker.stop()
+
     def test_stream_matches_unary_byte_identical(self):
         worker, server, client = self._worker()
         try:

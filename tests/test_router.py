@@ -1,0 +1,324 @@
+"""ClusterRouter unit/integration tests over IN-PROCESS replicas (two
+Worker + GrpcServer pairs — no broker, no subprocesses, so these stay
+fast): load balancing, drain semantics, breaker-backed failover, shed
+migration, stream routing with mid-stream failover, and policy-epoch
+trailer tracking.  The multi-process convergence story lives in
+tests/test_cluster_chaos.py."""
+
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from access_control_srv_tpu.srv import Worker
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.router import (
+    POLICY_EPOCH_METADATA_KEY,
+    SHED_METADATA_KEY,
+    ClusterRouter,
+)
+from access_control_srv_tpu.srv.transport_grpc import GrpcClient, GrpcServer
+
+from .cluster_util import command_over, seed_paths, wire_request
+
+pytestmark = pytest.mark.cluster
+
+
+def _worker_cfg(**overrides):
+    cfg = {
+        "policies": {"type": "database"},
+        "seed_data": seed_paths(),
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+@pytest.fixture()
+def replica_pair():
+    workers, servers = [], []
+    for _ in range(2):
+        worker = Worker().start(_worker_cfg())
+        server = GrpcServer(worker, "127.0.0.1:0").start()
+        workers.append(worker)
+        servers.append(server)
+    router = ClusterRouter(
+        [s.addr for s in servers],
+        cfg={"health_interval_s": 0.2, "max_retries": 1},
+    ).start()
+    client = GrpcClient(router.addr)
+    yield workers, servers, router, client
+    client.close()
+    router.stop()
+    for server in servers:
+        server.stop()
+    for worker in workers:
+        worker.stop()
+
+
+class TestUnaryRouting:
+    def test_decisions_and_load_balancing(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        for _ in range(10):
+            resp = client.is_allowed(wire_request())
+            assert resp.operation_status.code == 200
+            assert resp.decision == pb.PERMIT
+        status = router.status()
+        calls = {r["addr"]: r["calls"] for r in status["replicas"]}
+        # least-inflight on sequential traffic alternates; both serve
+        assert all(c > 0 for c in calls.values()), calls
+
+    def test_epoch_trailer_tracked(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        for _ in range(4):
+            client.is_allowed(wire_request())
+        status = router.status()
+        # seeded single workers have no CRUD frames: epoch 0, stamped
+        # on every response and observed by the router
+        assert [r["policy_epoch"] for r in status["replicas"]] == [0, 0]
+        assert status["converged"] is True
+
+    def test_trailer_stamp_on_direct_replica(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        direct = GrpcClient(servers[0].addr)
+        try:
+            fn = direct.channel.unary_unary(
+                "/acstpu.AccessControlService/IsAllowed",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.Response.FromString,
+            )
+            resp, call = fn.with_call(wire_request())
+            trailers = dict(call.trailing_metadata() or ())
+            assert trailers.get(POLICY_EPOCH_METADATA_KEY) == "0"
+            assert SHED_METADATA_KEY not in trailers
+        finally:
+            direct.close()
+
+    def test_drain_and_undrain(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        addr0 = servers[0].addr
+        result = command_over(client.channel, "cluster_drain",
+                              {"addr": addr0})
+        assert result["status"] == "draining"
+        before = {r["addr"]: r["calls"] for r in router.status()["replicas"]}
+        for _ in range(6):
+            assert client.is_allowed(
+                wire_request()
+            ).operation_status.code == 200
+        after = {r["addr"]: r["calls"] for r in router.status()["replicas"]}
+        assert after[addr0] == before[addr0]  # drained: no new calls
+        assert after[servers[1].addr] == before[servers[1].addr] + 6
+        result = command_over(client.channel, "cluster_undrain",
+                              {"addr": addr0})
+        assert result["status"] == "serving"
+
+    def test_all_drained_is_unavailable(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        command_over(client.channel, "cluster_drain", {})
+        with pytest.raises(grpc.RpcError) as excinfo:
+            client.is_allowed(wire_request())
+        assert excinfo.value.code() == grpc.StatusCode.UNAVAILABLE
+        command_over(client.channel, "cluster_undrain", {})
+
+    def test_replica_failure_retries_on_other(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        servers[0].stop(grace=0)
+        # every call succeeds: calls picked for the dead replica fail
+        # fast at transport and retry on the live one
+        for _ in range(8):
+            resp = client.is_allowed(wire_request())
+            assert resp.operation_status.code == 200
+        status = router.status()
+        by = {r["addr"]: r for r in status["replicas"]}
+        assert by[servers[1].addr]["calls"] >= 8 - by[
+            servers[0].addr
+        ]["failures"]
+        # the health poll marks the dead replica unhealthy shortly
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            by = {r["addr"]: r for r in router.status()["replicas"]}
+            if not by[servers[0].addr]["healthy"]:
+                break
+            time.sleep(0.1)
+        assert not by[servers[0].addr]["healthy"]
+
+    def test_command_forwarding(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        health = command_over(client.channel, "health_check")
+        assert health["status"] == "SERVING"
+        assert health["policy_epoch"] == 0
+        identity = command_over(client.channel, "program_identity")
+        assert identity["table_fingerprint"]
+
+
+class TestShedMigration:
+    def test_shed_request_retries_on_other_replica(self):
+        """Replica A sheds everything (admission queue bound 0); the
+        router must migrate the request to replica B instead of
+        surfacing A's 429."""
+        worker_a = Worker().start(_worker_cfg(
+            admission={"enabled": True, "max_queue_interactive": 0,
+                       "max_queue_bulk": 0},
+        ))
+        worker_b = Worker().start(_worker_cfg())
+        server_a = GrpcServer(worker_a, "127.0.0.1:0").start()
+        server_b = GrpcServer(worker_b, "127.0.0.1:0").start()
+        router = ClusterRouter(
+            [server_a.addr, server_b.addr],
+            cfg={"health_interval_s": 0.5, "max_retries": 1},
+        ).start()
+        client = GrpcClient(router.addr)
+        try:
+            # drain B so the first attempt must land on the shedding A
+            router.set_drain(server_b.addr, True)
+            direct = GrpcClient(server_a.addr)
+            shed = direct.is_allowed(wire_request())
+            assert shed.operation_status.code == 429
+            direct.close()
+            router.set_drain(server_b.addr, False)
+            router.set_drain(server_a.addr, False)
+            # through the router: A sheds with the x-acs-shed trailer,
+            # the router retries on B and the caller sees a decision
+            ok = 0
+            for _ in range(6):
+                resp = client.is_allowed(wire_request())
+                if resp.operation_status.code == 200:
+                    ok += 1
+            assert ok == 6
+            by = {r["addr"]: r for r in router.status()["replicas"]}
+            assert by[server_a.addr]["sheds"] >= 1
+            assert by[server_b.addr]["retries_absorbed"] >= 1
+        finally:
+            client.close()
+            router.stop()
+            server_a.stop()
+            server_b.stop()
+            worker_a.stop()
+            worker_b.stop()
+
+    def test_all_replicas_shedding_returns_honest_shed(self):
+        """When every replica sheds, the caller gets the shed response
+        (429) — never a fabricated decision, never a transport error."""
+        workers = [
+            Worker().start(_worker_cfg(
+                admission={"enabled": True, "max_queue_interactive": 0,
+                           "max_queue_bulk": 0},
+            ))
+            for _ in range(2)
+        ]
+        servers = [GrpcServer(w, "127.0.0.1:0").start() for w in workers]
+        router = ClusterRouter(
+            [s.addr for s in servers], cfg={"max_retries": 1},
+        ).start()
+        client = GrpcClient(router.addr)
+        try:
+            resp = client.is_allowed(wire_request())
+            assert resp.operation_status.code == 429
+        finally:
+            client.close()
+            router.stop()
+            for s in servers:
+                s.stop()
+            for w in workers:
+                w.stop()
+
+
+class TestStreamRouting:
+    def test_stream_through_router(self, replica_pair):
+        workers, servers, router, client = replica_pair
+        frames = [
+            pb.BatchRequest(requests=[wire_request(), wire_request()])
+            for _ in range(4)
+        ]
+        responses = list(client.is_allowed_stream(iter(frames), timeout=60))
+        assert len(responses) == 4
+        for frame in responses:
+            assert len(frame.responses) == 2
+            assert all(
+                r.operation_status.code == 200 for r in frame.responses
+            )
+
+    def test_stream_failover_replays_unanswered_tail(self, replica_pair):
+        """Kill the replica serving a stream between frames: the router
+        replays the unanswered frames on the other replica and the
+        client sees every response, in order, with no error."""
+        workers, servers, router, client = replica_pair
+        # pin the stream to replica 0
+        router.set_drain(servers[1].addr, True)
+
+        import queue
+
+        frame_q: "queue.Queue" = queue.Queue()
+        results: list = []
+        errors: list = []
+
+        def gen():
+            while True:
+                item = frame_q.get()
+                if item is None:
+                    return
+                yield item
+
+        def consume():
+            try:
+                for resp in client.is_allowed_stream(gen(), timeout=120):
+                    results.append(resp)
+            except BaseException as err:  # noqa: BLE001
+                errors.append(err)
+
+        consumer = threading.Thread(target=consume, daemon=True)
+        consumer.start()
+        frame = pb.BatchRequest(requests=[wire_request()])
+        frame_q.put(frame)
+        deadline = time.monotonic() + 30
+        while not results and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(results) == 1  # stream is live on replica 0
+        # open the fallback path, then kill the serving replica
+        router.set_drain(servers[1].addr, False)
+        servers[0].stop(grace=0)
+        for _ in range(3):
+            frame_q.put(frame)
+        frame_q.put(None)
+        consumer.join(timeout=60)
+        assert not consumer.is_alive()
+        assert not errors, errors
+        assert len(results) == 4
+        for resp in results:
+            assert resp.responses[0].operation_status.code == 200
+
+
+class TestLocalClusterCli:
+    def test_router_cli_mode(self):
+        """--router over one in-process replica: the CLI binds, reports
+        its address and proxies traffic."""
+        import subprocess
+        import sys
+
+        worker = Worker().start(_worker_cfg())
+        server = GrpcServer(worker, "127.0.0.1:0").start()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "access_control_srv_tpu", "--router",
+             "--replica", server.addr, "--addr", "127.0.0.1:0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=repo,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("routing on "), line
+            addr = line.split("routing on ", 1)[1].strip()
+            client = GrpcClient(addr)
+            resp = client.is_allowed(wire_request())
+            assert resp.operation_status.code == 200
+            client.close()
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+                proc.wait(timeout=10)
+            server.stop()
+            worker.stop()

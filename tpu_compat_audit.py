@@ -785,6 +785,83 @@ def main() -> int:
                  "(staging-arena misses zero on repeat encodes)"),
     })
 
+    # 9. cluster replica program identity: the pod-scale tier
+    # (parallel/cluster.py + srv/router.py) assumes that N replicas which
+    # applied the SAME CRUD journal hold byte-identical compiled tables
+    # and therefore run the byte-identical device program — the router's
+    # convergence check compares table fingerprints, and this row proves
+    # the fingerprint equality it relies on is real program identity:
+    # two independently-booted engine/evaluator/store stacks, same seed,
+    # same replayed CRUD sequence, compared array-bytes-for-array-bytes
+    # and lowered-HLO-for-lowered-HLO.
+    def _replica_stack():
+        eng = AccessController()
+        hyb = HybridEvaluator(eng)
+        st = PolicyStore(eng, evaluator=hyb)
+        st.seed(
+            [{"id": "s0", "combining_algorithm": DO5, "policies": ["p0"]}],
+            [{"id": "p0", "combining_algorithm": PO5,
+              "rules": [r["id"] for r in d_rules]}],
+            d_rules,
+        )
+        return eng, hyb, st
+
+    def _replay_crud(st):
+        # the same journal every replica would drain: mutate, grow past
+        # the seeded id space, shrink, mutate the newcomer
+        rules = st.get_resource_service("rule")
+        rules.update([_d_rule("r3", 3, effect="DENY")])
+        rules.create([_d_rule("r12", 12)])
+        rules.delete(ids=["r7"])
+        rules.upsert([_d_rule("r12", 12, effect="DENY")])
+
+    _eng_r1, hybrid_r1, store_r1 = _replica_stack()
+    _eng_r2, hybrid_r2, store_r2 = _replica_stack()
+    _replay_crud(store_r1)
+    _replay_crud(store_r2)
+    tbl_r1, tbl_r2 = hybrid_r1._compiled, hybrid_r2._compiled
+    arrays_identical = (
+        sorted(tbl_r1.arrays) == sorted(tbl_r2.arrays)
+        and all(
+            np.ascontiguousarray(tbl_r1.arrays[k]).tobytes()
+            == np.ascontiguousarray(tbl_r2.arrays[k]).tobytes()
+            and tbl_r1.arrays[k].dtype == tbl_r2.arrays[k].dtype
+            and tbl_r1.arrays[k].shape == tbl_r2.arrays[k].shape
+            for k in tbl_r1.arrays
+        )
+    )
+    fp_r1 = hybrid_r1.table_fingerprint()
+    fp_r2 = hybrid_r2.table_fingerprint()
+    replica_reqs = [_d_request(k) for k in range(12)]
+    hlo_r1 = _lower_dyn(tbl_r1, reqs=replica_reqs)
+    hlo_r2 = _lower_dyn(tbl_r2, reqs=replica_reqs)
+    served_r1 = hybrid_r1.is_allowed_batch(replica_reqs)
+    served_r2 = hybrid_r2.is_allowed_batch(replica_reqs)
+    decisions_identical = (
+        [r.decision for r in served_r1] == [r.decision for r in served_r2]
+    )
+    replica_ok = (
+        arrays_identical
+        and fp_r1 is not None and fp_r1 == fp_r2
+        and hlo_r1 == hlo_r2
+        and decisions_identical
+    )
+    results.append({
+        "kernel": "cluster-replica-program-identity",
+        "ok": bool(replica_ok),
+        "table_arrays_byte_identical": bool(arrays_identical),
+        "fingerprints_match": bool(fp_r1 is not None and fp_r1 == fp_r2),
+        "hlo_byte_identical": hlo_r1 == hlo_r2,
+        "decisions_identical": bool(decisions_identical),
+        "note": ("two independently-booted replica stacks replaying the "
+                 "same CRUD journal (update, create, delete, upsert) "
+                 "converge to byte-identical compiled table arrays, equal "
+                 "table fingerprints (the router's convergence probe), "
+                 "the byte-identical lowered device program, and "
+                 "identical served decisions — the cluster tier's "
+                 "program-identity invariant (docs/CLUSTER.md)"),
+    })
+
     verdict = {
         "backend": backend,
         "device": str(jax.devices()[0]),
