@@ -1,0 +1,35 @@
+"""acs-lint: AST-based concurrency and hot-path invariant analysis.
+
+Run as ``python -m access_control_srv_tpu.analysis``; library entry is
+``run_analysis``.  Zero runtime dependencies beyond the stdlib — the
+analyzer never imports the modules it checks, so it runs in any
+environment (CI images without jax included).  Rule catalog, annotation
+syntax, and the suppression policy live in docs/ANALYSIS.md; the
+runtime lock-order complement is ``analysis.locktrace``.
+"""
+
+from .baseline import BaselineEntry, diff as baseline_diff, load as load_baseline
+from .checks import check_module
+from .findings import ALL_RULES, Finding, Suppression
+from .runner import (
+    DEFAULT_BASELINE,
+    PACKAGE_ROOT,
+    Report,
+    render_report,
+    run_analysis,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "BaselineEntry",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "PACKAGE_ROOT",
+    "Report",
+    "Suppression",
+    "baseline_diff",
+    "check_module",
+    "load_baseline",
+    "render_report",
+    "run_analysis",
+]

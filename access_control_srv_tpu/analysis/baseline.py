@@ -1,0 +1,97 @@
+"""Checked-in suppression baseline for acs-lint.
+
+``analysis/baseline.json`` holds the findings the team has looked at
+and accepted, each with a one-line justification.  Entries are keyed
+``(path, rule, symbol)`` — no line numbers, so refactors that move code
+don't churn the file.  The runner fails on BOTH directions of drift:
+
+- a finding not in the baseline (new violation), and
+- a baseline entry whose finding no longer exists (stale suppression —
+  the code was fixed or the symbol renamed; the entry must be removed
+  so the suppression can't silently swallow a future regression).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    path: str
+    rule: str
+    symbol: str
+    justification: str = ""
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.symbol)
+
+
+@dataclass
+class BaselineDiff:
+    new: list[Finding]            # findings with no baseline entry
+    stale: list[BaselineEntry]    # entries with no live finding
+    unjustified: list[BaselineEntry]  # entries missing a justification
+    matched: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not (self.new or self.stale or self.unjustified)
+
+
+def load(path: str | Path) -> list[BaselineEntry]:
+    path = Path(path)
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    return [
+        BaselineEntry(
+            path=entry["path"], rule=entry["rule"],
+            symbol=entry["symbol"],
+            justification=entry.get("justification", ""),
+        )
+        for entry in data.get("suppressions", [])
+    ]
+
+
+def save(path: str | Path, findings: list[Finding],
+         justifications: dict[tuple[str, str, str], str] | None = None
+         ) -> None:
+    """Serialize findings as a fresh baseline (``--write-baseline``).
+    Existing justifications are carried over by key; new entries get an
+    empty justification the runner will refuse until filled in."""
+    justifications = justifications or {}
+    entries = [
+        {
+            "path": f.path, "rule": f.rule, "symbol": f.symbol,
+            "justification": justifications.get(f.key, ""),
+        }
+        for f in sorted(findings, key=lambda f: f.key)
+    ]
+    Path(path).write_text(json.dumps(
+        {"version": BASELINE_VERSION, "suppressions": entries}, indent=1,
+    ) + "\n")
+
+
+def diff(findings: list[Finding],
+         entries: list[BaselineEntry]) -> BaselineDiff:
+    finding_keys = {f.key for f in findings}
+    entry_keys = {e.key for e in entries}
+    return BaselineDiff(
+        new=sorted((f for f in findings if f.key not in entry_keys),
+                   key=lambda f: f.key),
+        stale=sorted((e for e in entries if e.key not in finding_keys),
+                     key=lambda e: e.key),
+        unjustified=sorted(
+            (e for e in entries
+             if e.key in finding_keys and not e.justification.strip()),
+            key=lambda e: e.key),
+        matched=len(finding_keys & entry_keys),
+    )

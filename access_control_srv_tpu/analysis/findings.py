@@ -1,0 +1,75 @@
+"""Finding model for acs-lint.
+
+A finding is identified by ``(path, rule, symbol)`` — deliberately **no
+line numbers** — so refactors that move code without changing what it
+does don't churn the checked-in baseline (docs/ANALYSIS.md).  ``line``
+and ``message`` ride along for human output only and never participate
+in identity, sorting beyond tie-breaks, or serialization to the
+baseline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# the rule catalog (docs/ANALYSIS.md) — names are stable: they appear in
+# inline suppressions (# acs-lint: ignore[rule]) and baseline.json
+RULE_GUARDED_BY = "guarded-by"
+RULE_BLOCKING_UNDER_LOCK = "blocking-under-lock"
+RULE_WALL_CLOCK = "wall-clock"
+RULE_HOST_ONLY_JAX = "host-only-jax"
+RULE_THREAD_LIFECYCLE = "thread-lifecycle"
+RULE_DISPATCH_PURITY = "dispatch-purity"
+
+ALL_RULES = (
+    RULE_GUARDED_BY,
+    RULE_BLOCKING_UNDER_LOCK,
+    RULE_WALL_CLOCK,
+    RULE_HOST_ONLY_JAX,
+    RULE_THREAD_LIFECYCLE,
+    RULE_DISPATCH_PURITY,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one symbol in one module."""
+
+    path: str    # repo-relative posix path of the module
+    rule: str    # one of ALL_RULES
+    symbol: str  # qualified symbol, e.g. "DecisionCache.get:self._epoch"
+    message: str = field(default="", compare=False)
+    line: int = field(default=0, compare=False)
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.symbol} — {self.message}")
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """An inline ``# acs-lint: ignore[rule]`` that actually absorbed a
+    finding — the tool counts these so silenced findings stay visible."""
+
+    path: str
+    rule: str
+    symbol: str
+    line: int
+    reason: str = ""
+
+
+def dedupe(findings: list[Finding]) -> list[Finding]:
+    """Stable de-duplication by identity key (the first occurrence's
+    line/message win — it's the lexically earliest site)."""
+    seen: set[tuple[str, str, str]] = set()
+    out: list[Finding] = []
+    for finding in findings:
+        if finding.key in seen:
+            continue
+        seen.add(finding.key)
+        out.append(finding)
+    return out

@@ -1,0 +1,289 @@
+"""Runtime lock-order detector: the dynamic complement of acs-lint.
+
+The static passes (checks.py) prove per-lock discipline — guarded state
+is only touched with its lock held — but deadlock needs a SECOND kind of
+invariant: a globally consistent acquisition ORDER.  Two threads taking
+``A then B`` and ``B then A`` can both be lock-clean and still deadlock
+under the right interleaving; no single-module lexical analysis sees it,
+and chaos soaks only catch it when the scheduler cooperates.
+
+``LockOrderWatch`` removes the scheduler from the equation: while
+installed, every ``threading.Lock``/``RLock`` CREATED is wrapped, each
+thread tracks its stack of held wrapped locks, and every acquisition
+with locks already held records a directed edge ``held -> acquiring`` in
+a process-wide graph.  A cycle in that graph is a deadlock the schedule
+merely hasn't dealt yet — the two orders only need to have HAPPENED, not
+to have overlapped, so a single-threaded test that takes ``A,B`` then
+``B,A`` sequentially still convicts.
+
+Scope and honesty:
+
+* Only locks created while the watch is installed are tracked (patching
+  the factory functions cannot reach pre-existing instances).  Tests
+  install the watch before constructing the system under soak.
+* Nodes are per-INSTANCE, labeled by creation site.  Sibling locks from
+  one construction site (shard locks) stay distinct, so a consistent
+  shard-ordering protocol is not a false cycle.
+* Re-entrant re-acquisition of a held RLock records no edge.
+* ``threading.Condition`` works unmodified: the wrappers delegate the
+  private ``_release_save``/``_acquire_restore``/``_is_owned`` hooks.
+
+Usage (tests/test_cluster_chaos.py, tests/test_pipeline.py soaks)::
+
+    with lock_order_watch() as watch:
+        ...  # build + drive the system
+    watch.assert_acyclic()  # raises LockOrderError with the cycle
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = [
+    "LockOrderError",
+    "LockOrderWatch",
+    "lock_order_watch",
+]
+
+
+class LockOrderError(AssertionError):
+    """A lock-order cycle was observed; ``cycle`` holds the node labels
+    in acquisition-edge order (first label repeats at the end)."""
+
+    def __init__(self, cycle: list[str]):
+        self.cycle = cycle
+        super().__init__(
+            "lock-order cycle (deadlock the scheduler hasn't dealt yet): "
+            + "  ->  ".join(cycle)
+        )
+
+
+def _creation_site() -> str:
+    """``file:line`` of the frame that called threading.Lock()/RLock(),
+    skipping this module's own frames."""
+    import sys
+
+    frame = sys._getframe(2)
+    while frame is not None and frame.f_globals.get("__name__") == __name__:
+        frame = frame.f_back
+    if frame is None:  # pragma: no cover — interpreter teardown
+        return "<unknown>"
+    return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+
+class _TrackedLock:
+    """Wrapper over a real lock primitive feeding the order graph.
+
+    Presents the full Lock/RLock surface plus the private hooks
+    ``threading.Condition`` uses, so a tracked lock can serve as a
+    condition's underlying lock.
+    """
+
+    def __init__(self, watch: "LockOrderWatch", inner, site: str, seq: int):
+        self._watch = watch
+        self._inner = inner
+        self.label = f"{site}#{seq}"
+
+    # ------------------------------------------------------------ acquire
+    def acquire(self, *args, **kwargs):
+        acquired = self._inner.acquire(*args, **kwargs)
+        if acquired:
+            self._watch._on_acquire(self)
+        return acquired
+
+    def release(self):
+        self._watch._on_release(self)
+        return self._inner.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    # ------------------------------------- threading.Condition delegation
+    # Condition lifts these from its lock when present; the wrapper always
+    # has them, so it must emulate Condition's own fallbacks when the
+    # inner primitive (a plain Lock) lacks the private hooks.
+    def _release_save(self):
+        # the condition fully releases a held (possibly re-entrant) lock
+        # around wait(): mirror that in the held stack
+        self._watch._on_release(self, full=True)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._watch._on_acquire(self)
+
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<tracked {self._inner!r} @ {self.label}>"
+
+
+class LockOrderWatch:
+    """Process-wide acquisition-order graph over tracked lock instances.
+
+    ``install()`` patches ``threading.Lock``/``threading.RLock`` (the
+    factory callables) so every lock constructed afterwards is tracked;
+    ``uninstall()`` restores them.  The graph and its edge provenance
+    survive uninstall for assertion."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # edges: held_label -> {acquired_label}; provenance keeps one
+        # (held, acquired) -> thread name sample for the error message
+        self._edges: dict[str, set[str]] = {}       # guarded-by: _lock
+        self._provenance: dict[tuple, str] = {}     # guarded-by: _lock
+        self._labels: set[str] = set()              # guarded-by: _lock
+        self._seq = 0                               # guarded-by: _lock
+        self._held = threading.local()  # per-thread stack of _TrackedLock
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # ------------------------------------------------------------ factory
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _make(self, factory) -> _TrackedLock:
+        tracked = _TrackedLock(
+            self, factory(), _creation_site(), self._next_seq()
+        )
+        with self._lock:
+            self._labels.add(tracked.label)
+        return tracked
+
+    def install(self) -> "LockOrderWatch":
+        if self._orig_lock is not None:
+            raise RuntimeError("LockOrderWatch already installed")
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        threading.Lock = lambda: self._make(self._orig_lock)
+        threading.RLock = lambda: self._make(self._orig_rlock)
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig_lock is None:
+            return
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # ----------------------------------------------------------- tracking
+    def _stack(self) -> list:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _on_acquire(self, lock: _TrackedLock) -> None:
+        stack = self._stack()
+        if any(held is lock for held in stack):
+            stack.append(lock)  # re-entrant RLock: no new edge
+            return
+        if stack:
+            holder = threading.current_thread().name
+            with self._lock:
+                for held in stack:
+                    self._edges.setdefault(held.label, set()).add(lock.label)
+                    self._provenance.setdefault(
+                        (held.label, lock.label), holder
+                    )
+        stack.append(lock)
+
+    def _on_release(self, lock: _TrackedLock, full: bool = False) -> None:
+        stack = self._stack()
+        # remove the most recent occurrence (LIFO discipline is the
+        # overwhelmingly common case; out-of-order release still tracks)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                if not full:
+                    return
+        # full=True (condition wait) drops every re-entrant occurrence
+
+    # ---------------------------------------------------------- assertion
+    def edges(self) -> dict[str, set[str]]:
+        with self._lock:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def find_cycle(self) -> list[str] | None:
+        """First cycle in the acquisition graph as a label path (closed:
+        path[0] == path[-1]); None when acyclic."""
+        graph = self.edges()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = dict.fromkeys(graph, WHITE)
+        parent: dict[str, str] = {}
+
+        def dfs(root: str) -> list[str] | None:
+            stack = [(root, iter(sorted(graph.get(root, ()))))]
+            color[root] = GRAY
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for succ in it:
+                    state = color.get(succ, WHITE)
+                    if state == GRAY:
+                        cycle = [succ, node]
+                        cur = node
+                        while cur != succ:
+                            cur = parent[cur]
+                            cycle.append(cur)
+                        cycle.reverse()
+                        return cycle
+                    if state == WHITE:
+                        color[succ] = GRAY
+                        parent[succ] = node
+                        stack.append((succ, iter(sorted(graph.get(succ, ())))))
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+            return None
+
+        for root in sorted(graph):
+            if color.get(root, WHITE) == WHITE:
+                cycle = dfs(root)
+                if cycle is not None:
+                    return cycle
+        return None
+
+    def assert_acyclic(self) -> None:
+        cycle = self.find_cycle()
+        if cycle is not None:
+            raise LockOrderError(cycle)
+
+
+@contextmanager
+def lock_order_watch():
+    """Install a fresh watch for the duration of the block; the caller
+    asserts (``watch.assert_acyclic()``) AFTER the block, once the system
+    under soak has been torn down."""
+    watch = LockOrderWatch()
+    watch.install()
+    try:
+        yield watch
+    finally:
+        watch.uninstall()

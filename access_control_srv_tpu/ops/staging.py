@@ -46,12 +46,12 @@ class HostBufferPool:
     def __init__(self, max_per_key: int = 8):
         self.max_per_key = int(max_per_key)
         self._lock = threading.Lock()
-        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._free: dict[tuple, list[np.ndarray]] = {}  # guarded-by: _lock
         # id(buffer) -> key, for every buffer currently leased out
-        self._leased: dict[int, tuple] = {}
-        self.hits = 0
-        self.misses = 0
-        self.releases = 0
+        self._leased: dict[int, tuple] = {}  # guarded-by: _lock
+        self.hits = 0     # guarded-by: _lock
+        self.misses = 0   # guarded-by: _lock
+        self.releases = 0  # guarded-by: _lock
 
     @staticmethod
     def _key(shape, dtype) -> tuple:
@@ -110,7 +110,7 @@ class HostBufferPool:
             }
 
 
-_default: Optional[HostBufferPool] = None
+_default: Optional[HostBufferPool] = None  # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

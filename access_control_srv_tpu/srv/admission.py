@@ -43,6 +43,9 @@ tests/test_admission.py's differential check).
 
 from __future__ import annotations
 
+# acs-lint: host-only — admission decisions must stay off the device
+# runtime (tpu_compat_audit row admission-zero-device-ops)
+
 import random
 import threading
 import time
@@ -135,9 +138,9 @@ class LatencyEwma:
     def __init__(self, alpha: float = 0.2, default_s: float = 0.005):
         self.alpha = float(alpha)
         self.default_s = float(default_s)
-        self._value: Optional[float] = None
-        self._dev = 0.0
-        self._per_row: Optional[float] = None
+        self._value: Optional[float] = None   # guarded-by: _lock
+        self._dev = 0.0                       # guarded-by: _lock
+        self._per_row: Optional[float] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, seconds: float, rows: int = 1) -> None:
@@ -224,26 +227,26 @@ class CircuitBreaker:
         self._time = time_fn
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
-        self._state = self.CLOSED
-        self._outcomes: list[tuple[float, bool]] = []  # (t, ok)
-        self._opened_at = 0.0
-        self._reopen_after = 0.0
-        self._probes_inflight = 0
-        self._transitions = {"opens": 0, "closes": 0, "fast_fails": 0}
+        self._state = self.CLOSED  # guarded-by: _lock
+        self._outcomes: list[tuple[float, bool]] = []  # (t, ok)  # guarded-by: _lock
+        self._opened_at = 0.0      # guarded-by: _lock
+        self._reopen_after = 0.0   # guarded-by: _lock
+        self._probes_inflight = 0  # guarded-by: _lock
+        self._transitions = {"opens": 0, "closes": 0, "fast_fails": 0}  # guarded-by: _lock
 
     # ------------------------------------------------------------- helpers
 
-    def _count(self, key: str) -> None:
+    def _count(self, key: str) -> None:  # holds: _lock
         self._transitions[key] = self._transitions.get(key, 0) + 1
         if self._counter is not None:
             self._counter.inc(f"breaker-{self.name}-{key.rstrip('s')}")
 
-    def _prune(self, now: float) -> None:
+    def _prune(self, now: float) -> None:  # holds: _lock
         cutoff = now - self.window_s
         if self._outcomes and self._outcomes[0][0] < cutoff:
             self._outcomes = [o for o in self._outcomes if o[0] >= cutoff]
 
-    def _open(self, now: float) -> None:
+    def _open(self, now: float) -> None:  # holds: _lock
         self._state = self.OPEN
         self._opened_at = now
         # jittered cooldown: 1.0x..1.5x open_s so replicas don't probe a
@@ -320,12 +323,13 @@ class CircuitBreaker:
             now = self._time()
             if state == self.OPEN and now >= self._reopen_after:
                 state = self.HALF_OPEN
+            transitions = dict(self._transitions)
         failures = sum(1 for _, ok in window if not ok)
         return {
             "state": state,
             "window_calls": len(window),
             "window_failures": failures,
-            **self._transitions,
+            **transitions,
         }
 
 
@@ -376,20 +380,20 @@ class AdmissionController:
         self.telemetry = telemetry
         self._time = time_fn
         self._lock = threading.Lock()
-        self._depth = {INTERACTIVE: 0, BULK: 0}
-        self._max_depth_seen = {INTERACTIVE: 0, BULK: 0}
+        self._depth = {INTERACTIVE: 0, BULK: 0}           # guarded-by: _lock
+        self._max_depth_seen = {INTERACTIVE: 0, BULK: 0}  # guarded-by: _lock
         self._ewma = {
             INTERACTIVE: LatencyEwma(ewma_alpha, ewma_default_ms / 1e3),
             BULK: LatencyEwma(ewma_alpha, ewma_default_ms / 1e3),
         }
-        self._adaptive_max: Optional[int] = None
+        self._adaptive_max: Optional[int] = None  # guarded-by: _lock
         self._last_batch_full = False
-        self._draining = False
-        self._stats = {
+        self._draining = False  # guarded-by: _lock
+        self._stats = {  # guarded-by: _lock
             "admitted": 0, "shed_queue_full": 0, "deadline_rejected": 0,
             "deadline_expired": 0, "shed_shutdown": 0,
         }
-        self.breakers: dict[str, CircuitBreaker] = {}
+        self.breakers: dict[str, CircuitBreaker] = {}  # guarded-by: _lock
 
     # ----------------------------------------------------------- construction
 
@@ -461,6 +465,9 @@ class AdmissionController:
         immediately."""
         if not self.enabled:
             return None
+        # acs-lint: ignore[guarded-by] benign racy read of a one-way flag:
+        # a request admitted during the begin_drain() window still drains
+        # within the batcher's drain deadline
         if self._draining:
             self._count("shed_shutdown")
             return overload_response(SHUTDOWN_CODE, "shutting down")
@@ -594,10 +601,12 @@ class AdmissionController:
         """Stop admitting (every subsequent admit sheds with the shutdown
         status); already-admitted work keeps flowing until the batcher's
         drain deadline."""
-        self._draining = True
+        with self._lock:
+            self._draining = True
 
     @property
     def draining(self) -> bool:
+        # acs-lint: ignore[guarded-by] benign racy read of a one-way flag
         return self._draining
 
     # ---------------------------------------------------------------- stats
@@ -614,11 +623,12 @@ class AdmissionController:
                 "max_queue": dict(self.max_queue),
                 "adaptive_max_batch": self._adaptive_max,
             }
+            breakers = dict(self.breakers)
         out["batch_latency_estimate_ms"] = {
             cls: round(ewma.estimate() * 1e3, 3)
             for cls, ewma in self._ewma.items()
         }
         out["breakers"] = {
-            name: breaker.stats() for name, breaker in self.breakers.items()
+            name: breaker.stats() for name, breaker in breakers.items()
         }
         return out

@@ -86,17 +86,17 @@ class BrokerServer:
                 "TCP — use a TLS tunnel or a trusted network segment",
                 file=_sys.stderr,
             )
-        self._topics: dict[str, list[tuple[str, Any]]] = {}
-        self._kv: dict[str, Any] = {}
-        self._consumer_offsets: dict[str, int] = {}
-        self._subscribers: dict[str, list[queue.Queue]] = {}
+        self._topics: dict[str, list[tuple[str, Any]]] = {}   # guarded-by: _lock
+        self._kv: dict[str, Any] = {}                         # guarded-by: _lock
+        self._consumer_offsets: dict[str, int] = {}           # guarded-by: _lock
+        self._subscribers: dict[str, list[queue.Queue]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.secret = secret
-        self._journal = None
+        self._journal = None  # guarded-by: _lock
         self.fsync_interval_s = (
             None if fsync_interval_s is None else float(fsync_interval_s)
         )
-        self._last_fsync = 0.0
+        self._last_fsync = 0.0  # guarded-by: _lock
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             path = os.path.join(data_dir, "broker.journal")
@@ -158,6 +158,7 @@ class BrokerServer:
                 self._journal = None
 
     # ----------------------------------------------------------- durability
+    # holds: _lock (trivially exclusive: runs in __init__ before the server thread starts)
     def _replay_journal(self, path: str) -> None:
         """Rebuild topics / KV / consumer offsets from the journal; a torn
         trailing record (crash mid-append) is skipped."""
@@ -185,7 +186,7 @@ class BrokerServer:
                 elif kind == "co":
                     self._consumer_offsets[rec["t"]] = rec["o"]
 
-    def _log(self, rec: dict) -> None:
+    def _log(self, rec: dict) -> None:  # holds: _lock
         """Append one journal record; caller holds self._lock."""
         if self._journal is not None:
             self._journal.write(json.dumps(rec) + "\n")
@@ -475,7 +476,7 @@ class SocketEventBus:
         self.address = address
         self._secret = secret
         self._rpc = _Rpc(address, secret=secret)
-        self._topics: dict[str, SocketTopic] = {}
+        self._topics: dict[str, SocketTopic] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def topic(self, name: str) -> SocketTopic:
@@ -487,10 +488,13 @@ class SocketEventBus:
             return self._topics[name]
 
     def topics(self) -> dict[str, SocketTopic]:
-        return dict(self._topics)
+        with self._lock:
+            return dict(self._topics)
 
     def close(self) -> None:
-        for topic in self._topics.values():
+        with self._lock:
+            topics = list(self._topics.values())
+        for topic in topics:
             topic.close()
         self._rpc.close()
 

@@ -27,7 +27,7 @@ class SubjectCache:
     """Key-value cache with prefix eviction (Redis DB-subject analog)."""
 
     def __init__(self):
-        self._data: dict[str, Any] = {}
+        self._data: dict[str, Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def get(self, key: str) -> Any:
@@ -75,8 +75,8 @@ class HRScopeProvider:
         self.logger = logger
         # token_date -> number of parked waiters; released token_dates are
         # marked until their last waiter drains
-        self.waiting: dict[str, int] = {}
-        self._released: set[str] = set()
+        self.waiting: dict[str, int] = {}  # guarded-by: _cond
+        self._released: set[str] = set()   # guarded-by: _cond
         self._cond = threading.Condition()
 
     def hr_scopes_key(self, context) -> Optional[str]:

@@ -29,6 +29,8 @@ class CommandInterface:
         self.logger = logger
         self.worker = worker  # cluster-tier surfaces (epoch, identity)
         self.api_key: Optional[str] = None
+        # acs-lint: ignore[wall-clock] human-facing uptime epoch stamp —
+        # never used in deadline or TTL arithmetic
         self.start_time = time.time()
         if bus is not None:
             bus.topic("io.restorecommerce.command").on(self._on_command)
@@ -169,6 +171,7 @@ class CommandInterface:
             detail["error"] = str(err)
         return {
             "status": "SERVING" if healthy else "NOT_SERVING",
+            # acs-lint: ignore[wall-clock] human-facing uptime display
             "uptime_s": round(time.time() - self.start_time, 3),
             **detail,
         }

@@ -54,6 +54,9 @@ bit-identical decision streams under randomized CRUD interleavings.
 
 from __future__ import annotations
 
+# acs-lint: host-only — the lookup path must never touch the device
+# runtime (tpu_compat_audit row decision-cache-lookup)
+
 import threading
 import time
 from collections import OrderedDict, deque, namedtuple
@@ -201,7 +204,7 @@ class _Shard:
         # key -> (decision, obligations tuple, cacheable, code, message,
         #         epoch, expires_at, features); OrderedDict order IS the
         # LRU order
-        self.entries: OrderedDict[str, tuple] = OrderedDict()
+        self.entries: OrderedDict[str, tuple] = OrderedDict()  # guarded-by: lock
 
 
 class DecisionCache:
@@ -227,17 +230,17 @@ class DecisionCache:
         self._per_shard = max(1, self.max_entries // n)
         self._time = time_fn
         self.telemetry = telemetry
-        self._epoch = 0
+        self._epoch = 0  # guarded-by: _stats_lock
         # (epoch, footprint-or-None) per bump, newest last; None = global.
         # Bounded: anything older than the log is treated as global.
-        self._bumps: deque = deque(maxlen=_BUMP_LOG)
+        self._bumps: deque = deque(maxlen=_BUMP_LOG)  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
-        self._hits = 0
-        self._misses = 0
-        self._evictions = 0
-        self._stores = 0
-        self._scoped_bumps = 0
-        self._scoped_survivors = 0
+        self._hits = 0        # guarded-by: _stats_lock
+        self._misses = 0      # guarded-by: _stats_lock
+        self._evictions = 0   # guarded-by: _stats_lock
+        self._stores = 0      # guarded-by: _stats_lock
+        self._scoped_bumps = 0      # guarded-by: _stats_lock
+        self._scoped_survivors = 0  # guarded-by: _stats_lock
 
     # ---------------------------------------------------------------- stats
 
@@ -253,6 +256,11 @@ class DecisionCache:
             evictions, stores = self._evictions, self._stores
             scoped_bumps = self._scoped_bumps
             scoped_survivors = self._scoped_survivors
+            epoch = self._epoch
+        entries = 0
+        for shard in self._shards:
+            with shard.lock:
+                entries += len(shard.entries)
         lookups = hits + misses
         return {
             "enabled": self.enabled,
@@ -261,8 +269,8 @@ class DecisionCache:
             "evictions": evictions,
             "stores": stores,
             "hit_ratio": round(hits / lookups, 4) if lookups else None,
-            "entries": sum(len(s.entries) for s in self._shards),
-            "epoch": self._epoch,
+            "entries": entries,
+            "epoch": epoch,
             "scoped_bumps": scoped_bumps,
             "scoped_survivors": scoped_survivors,
             "ttl_s": self.ttl_s,
@@ -282,6 +290,7 @@ class DecisionCache:
         whose evaluation spans an epoch bump (CRUD hot-sync / restore
         completing mid-walk) is then stored under the old epoch and is a
         logical miss, never served as fresh."""
+        # acs-lint: ignore[guarded-by] epoch snapshot read: atomic int load; snapshot-before-walk semantics (PR 1)
         return self._epoch
 
     def _shard(self, key: str) -> _Shard:
@@ -297,6 +306,7 @@ class DecisionCache:
         always count, scoped bumps count when their footprint intersects.
         Feature-less entries (pre-delta callers) are affected by every
         bump — identical to the original epoch semantics."""
+        # acs-lint: ignore[guarded-by] epoch snapshot read: atomic int load; staleness re-checked against the bump log below
         current = self._epoch
         if entry_epoch == current:
             return False
@@ -329,6 +339,7 @@ class DecisionCache:
         if not self.enabled or key is None:
             return None
         shard = self._shard(key)
+        # acs-lint: ignore[guarded-by] epoch snapshot read: atomic int load taken BEFORE the entry check (PR 1 snapshot-before-walk)
         epoch = self._epoch
         now = self._time()
         with shard.lock:
@@ -398,11 +409,12 @@ class DecisionCache:
         status = response.operation_status
         if status is not None and status.code != 200:
             return False
+        # acs-lint: ignore[guarded-by] epoch snapshot reads: atomic int loads; a concurrent bump makes the entry born-stale, never served fresh
         ent_epoch = self._epoch if epoch is None else int(epoch)
-        if ent_epoch != self._epoch:
+        if ent_epoch != self._epoch:  # acs-lint: ignore[guarded-by] epoch snapshot read (see above)
             if self._affected_between(ent_epoch, features):
                 return False
-            ent_epoch = self._epoch  # disjoint scoped bumps only: fresh
+            ent_epoch = self._epoch  # acs-lint: ignore[guarded-by] epoch snapshot read (see above)
         entry = (
             response.decision,
             tuple(response.obligations or ()),

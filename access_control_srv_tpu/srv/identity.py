@@ -61,10 +61,10 @@ class TokenResolutionCache:
         self._time = time_fn
         self._counter = counter
         # token -> (expires_at, subject_id, envelope); dict order is the LRU
-        self._data: dict[str, tuple[float, Optional[str], dict]] = {}
+        self._data: dict[str, tuple[float, Optional[str], dict]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._gen = 0
-        self._stats = {
+        self._gen = 0  # guarded-by: _lock
+        self._stats = {  # guarded-by: _lock
             "hits": 0, "misses": 0, "negative_hits": 0,
             "evictions": 0, "expirations": 0,
         }
@@ -73,7 +73,7 @@ class TokenResolutionCache:
         with self._lock:
             return len(self._data)
 
-    def _count(self, key: str, by: int = 1) -> None:
+    def _count(self, key: str, by: int = 1) -> None:  # holds: _lock
         self._stats[key] += by
         if self._counter is not None:
             self._counter.inc(key.replace("_", "-"), by)

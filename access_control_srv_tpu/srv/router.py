@@ -31,6 +31,9 @@ them:
 
 from __future__ import annotations
 
+# acs-lint: host-only — the router proxies raw bytes between processes
+# and must never pull the device runtime into its address space
+
 import json
 import threading
 import time
@@ -147,7 +150,7 @@ class ClusterRouter:
         self.logger = logger
         self._lock = threading.Lock()
         breaker_cfg = cfg.get("breaker") or {}
-        self.replicas = [
+        self.replicas = [  # guarded-by: _lock
             ReplicaHandle(a, breaker_cfg) for a in replica_addrs
         ]
         self.health_interval_s = float(cfg.get("health_interval_s", 1.0))
@@ -156,9 +159,9 @@ class ClusterRouter:
         )
         self.max_retries = int(cfg.get("max_retries", 1))
         self.overhead = Histogram()  # router-added seconds per unary call
-        self.retries = 0
-        self.unroutable = 0
-        self._rr = 0  # round-robin cursor for inflight ties
+        self.retries = 0     # guarded-by: _lock
+        self.unroutable = 0  # guarded-by: _lock
+        self._rr = 0  # round-robin cursor for inflight ties  # guarded-by: _lock
         self._stop = threading.Event()
         self.server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -181,7 +184,9 @@ class ClusterRouter:
     def stop(self, grace: float = 0.5) -> None:
         self._stop.set()
         self.server.stop(grace)
-        for replica in self.replicas:
+        with self._lock:
+            replicas = list(self.replicas)
+        for replica in replicas:
             try:
                 replica.channel.close()
             except Exception:  # noqa: BLE001
@@ -213,6 +218,9 @@ class ClusterRouter:
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_interval_s):
+            # acs-lint: ignore[guarded-by] benign racy snapshot: add/remove
+            # REBIND self.replicas (never mutate in place), so list() over
+            # the old reference iterates a consistent replica set
             for replica in list(self.replicas):
                 self._poll(replica)
 
@@ -556,6 +564,8 @@ class ClusterRouter:
     def status(self) -> dict:
         with self._lock:
             replicas = [r.snapshot() for r in self.replicas]
+            retries = self.retries
+            unroutable = self.unroutable
         epochs = [r["policy_epoch"] for r in replicas]
         snap = self.overhead.snapshot()
         return {
@@ -564,8 +574,8 @@ class ClusterRouter:
             "converged": len(set(epochs)) <= 1,
             "min_epoch": min(epochs) if epochs else None,
             "max_epoch": max(epochs) if epochs else None,
-            "retries": self.retries,
-            "unroutable": self.unroutable,
+            "retries": retries,
+            "unroutable": unroutable,
             "router_overhead": {
                 "count": snap["count"],
                 "p50_ms": round(snap["p50_s"] * 1e3, 3)

@@ -30,6 +30,7 @@ import time
 import uuid
 from typing import Callable, Optional
 
+from .clock import monotonic_wall
 from ..core.engine import AccessController
 from ..core.loader import policy_from_dict, policy_set_from_dict, rule_from_dict
 from ..models.model import Decision
@@ -57,12 +58,12 @@ class Collection:
     def __init__(self, name: str, snapshot_dir: Optional[str] = None,
                  compact_every: int = 1024):
         self.name = name
-        self._docs: dict[str, dict] = {}
+        self._docs: dict[str, dict] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.snapshot_dir = snapshot_dir
         self.compact_every = compact_every
-        self._journal_fh = None
-        self._journal_records = 0
+        self._journal_fh = None       # guarded-by: _lock
+        self._journal_records = 0     # guarded-by: _lock
         if snapshot_dir:
             path = os.path.join(snapshot_dir, f"{name}.json")
             if os.path.exists(path):
@@ -89,7 +90,7 @@ class Collection:
     def _journal_path(self) -> str:
         return os.path.join(self.snapshot_dir, f"{self.name}.journal")
 
-    def _append(self, rec: dict) -> None:
+    def _append(self, rec: dict) -> None:  # holds: _lock
         """One O(doc) journal record; caller holds self._lock.  Rolls the
         journal into a fresh snapshot past the compaction threshold."""
         if not self.snapshot_dir:
@@ -105,7 +106,7 @@ class Collection:
         self._journal_fh.flush()
         self._journal_records += 1
 
-    def _snapshot(self):
+    def _snapshot(self):  # holds: _lock
         """Full rewrite + journal truncation; caller holds self._lock."""
         if not self.snapshot_dir:
             return
@@ -310,7 +311,10 @@ class ResourceService:
                     ],
                 }
             )
-        now = time.time()
+        # monotonic-anchored: meta.modified/created are ordering-sensitive
+        # stored stamps — a raw time.time() stepping backward under NTP
+        # slew would reorder document history (srv/clock.py)
+        now = monotonic_wall()
         for item in items:
             meta = item.setdefault("meta", {})
             # timestamp stamping (reference: resource-base fieldHandlers
@@ -698,14 +702,14 @@ class PolicyReplicator:
         self.logger = logger
         self.debounce_s = debounce_s
         self._lock = threading.Lock()
-        self._timer: Optional[threading.Timer] = None
-        self._stopped = False
-        self._applied = 0
+        self._timer: Optional[threading.Timer] = None  # guarded-by: _lock
+        self._stopped = False  # guarded-by: _lock
+        self._applied = 0  # guarded-by: _lock
         # CRUD events captured per applied frame (old doc read before the
         # upsert/delete): the debounced sync hands them to store.load so
         # remote mutations get the same delta patch + scoped invalidation
         # as local ones
-        self._pending_events: list = []
+        self._pending_events: list = []  # guarded-by: _lock
         # policy-epoch bookkeeping (cluster tier, srv/router.py): highest
         # broker offset OBSERVED per CRUD topic, and the highest offset
         # whose effect is REFLECTED in the serving tree (own-origin frames
@@ -713,8 +717,8 @@ class PolicyReplicator:
         # sum(applied+1) is the replica's policy epoch — the number every
         # response is stamped with, so the router and the stale-decision
         # oracle can compare replica states without reading the trees.
-        self.offsets: dict[str, int] = {}
-        self.applied_offsets: dict[str, int] = {}
+        self.offsets: dict[str, int] = {}          # guarded-by: _lock
+        self.applied_offsets: dict[str, int] = {}  # guarded-by: _lock
         self._topics = {
             self.store.services[kind].topic.name: kind
             for kind in ("rule", "policy", "policy_set")
@@ -755,6 +759,9 @@ class PolicyReplicator:
                 )
 
     def _on_event(self, event_name: str, message, ctx: dict) -> None:
+        # acs-lint: ignore[guarded-by] benign racy fast-path: a frame that
+        # slips past a concurrent stop() is applied to collections that are
+        # about to be discarded; _schedule_sync re-checks under the lock
         if self._stopped:
             return
         topic = ctx.get("topic")
@@ -812,7 +819,8 @@ class PolicyReplicator:
             if offset >= 0:
                 self._mark_applied(topic, offset)  # quarantined, not pending
             return
-        self._applied += 1
+        with self._lock:
+            self._applied += 1
         self._schedule_sync(event, topic=topic, offset=offset)
 
     def _schedule_sync(self, event=None, topic=None, offset=-1) -> None:

@@ -170,9 +170,9 @@ class Histogram:
     """Fixed-bucket latency histogram; thread-safe, O(1) observe."""
 
     def __init__(self):
-        self._counts = [0] * len(_BUCKETS)
-        self._sum = 0.0
-        self._n = 0
+        self._counts = [0] * len(_BUCKETS)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._n = 0      # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, seconds: float) -> None:
@@ -217,10 +217,10 @@ class ValueHistogram:
               65536, float("inf")]
 
     def __init__(self):
-        self._counts = [0] * len(self.BOUNDS)
-        self._sum = 0.0
-        self._n = 0
-        self._max = 0.0
+        self._counts = [0] * len(self.BOUNDS)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._n = 0      # guarded-by: _lock
+        self._max = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -259,7 +259,7 @@ class ValueHistogram:
 
 class Counter:
     def __init__(self):
-        self._values: dict[str, int] = {}
+        self._values: dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, key: str, by: int = 1) -> None:
@@ -295,7 +295,7 @@ class SampledLogger:
         self._time = time_fn
         self._lock = threading.Lock()
         # key -> [window_start, emitted_in_window, suppressed_in_window]
-        self._state: dict[str, list] = {}
+        self._state: dict[str, list] = {}  # guarded-by: _lock
 
     def _gate(self, key: str) -> tuple[bool, int]:
         """(emit_now, suppressed_to_report): whether THIS record may log,
@@ -523,7 +523,10 @@ class Telemetry:
         # here): stage name -> Histogram.  Empty unless tracing is
         # enabled, so the snapshot/exposition surface only grows when the
         # operator asked for attribution.
-        self.stages: dict[str, Histogram] = {}
+        self.stages: dict[str, Histogram] = {}  # guarded-by: _snapshot_lock
+        # acs-lint: ignore[wall-clock] human-facing uptime epoch stamp —
+        # operators expect a wall-time "since" value; never used in
+        # deadline or TTL arithmetic
         self.start_time = time.time()
         self._snapshot_lock = threading.Lock()
         self.registry = MetricsRegistry()
@@ -532,6 +535,7 @@ class Telemetry:
     def _register_all(self) -> None:
         reg = self.registry
         reg.gauge("acs_uptime_seconds", "Worker uptime",
+                  # acs-lint: ignore[wall-clock] human-facing uptime display
                   lambda: round(time.time() - self.start_time, 3))
         reg.histogram("acs_is_allowed_latency_seconds",
                       "isAllowed end-to-end latency", self.is_allowed_latency)
@@ -568,11 +572,21 @@ class Telemetry:
         reg.histogram_group(
             "acs_stage_duration_seconds",
             "Per-stage pipeline duration (srv/tracing.py taxonomy)",
-            lambda: self.stages, label="stage",
+            self._stages_view, label="stage",
         )
+
+    def _stages_view(self) -> dict:
+        """Consistent copy of the stage-histogram map for render():
+        iterating the LIVE dict while stage_histogram inserts a late-bound
+        stage raises ``dict changed size during iteration`` mid-scrape."""
+        with self._snapshot_lock:
+            return dict(self.stages)
 
     def stage_histogram(self, stage: str) -> Histogram:
         """The (lazily created) histogram for one pipeline stage."""
+        # acs-lint: ignore[guarded-by] benign racy fast path: a dict.get
+        # miss falls through to the locked setdefault; entries are never
+        # removed, so a hit is always the canonical histogram
         hist = self.stages.get(stage)
         if hist is None:
             with self._snapshot_lock:
@@ -606,6 +620,7 @@ class Telemetry:
         # nests by reference)
         with self._snapshot_lock:
             out = {
+                # acs-lint: ignore[wall-clock] human-facing uptime display
                 "uptime_s": round(time.time() - self.start_time, 3),
                 "is_allowed_latency": self.is_allowed_latency.snapshot(),
                 "what_is_allowed_latency":

@@ -41,6 +41,10 @@ path is byte-identical to pre-observability behavior
 
 from __future__ import annotations
 
+# acs-lint: host-only — tracing must never import jax; a traced batch
+# lowers to the byte-identical device program (tpu_compat_audit row
+# tracing-zero-device-ops)
+
 import logging
 import os
 import random
@@ -146,7 +150,7 @@ class StageTracer:
         self.telemetry = telemetry
         self.sample_rate = float(sample_rate)
         self._rng = rng or random.Random()
-        self._traces: deque = deque(maxlen=int(max_traces))
+        self._traces: deque = deque(maxlen=int(max_traces))  # guarded-by: _lock
         self._lock = threading.Lock()
         # local histogram store when no Telemetry is wired (unit tests)
         self._own_stages: dict = {}

@@ -66,7 +66,7 @@ class Worker:
         # live CRUD-offset watermark per topic (policy_epoch fallback for
         # workers without a replicator)
         self._epoch_lock = threading.Lock()
-        self._crud_offsets: dict = {}
+        self._crud_offsets: dict = {}  # guarded-by: _epoch_lock
 
     def start(
         self,

@@ -1,0 +1,48 @@
+"""acs-lint fixture: blocking calls lexically under a lock.
+
+Expected findings:
+  * Pump.stall:time.sleep            (sleep under the lock)
+  * Pump.drain:self.jobs.get         (queue get with timeout under lock)
+  * Pump.flush:os.fsync              (fsync inside a holds: helper)
+Not findings: cond.wait_for on the held condition, dict .get, str.join,
+queue get OUTSIDE the lock.
+"""
+
+import os
+import queue
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.jobs = queue.Queue()
+        self.table = {}
+        self.fh = None
+
+    def stall(self):
+        with self._lock:
+            time.sleep(0.01)  # FINDING
+
+    def drain(self):
+        with self._lock:
+            return self.jobs.get(timeout=1.0)  # FINDING
+
+    def flush(self):  # holds: _lock
+        os.fsync(self.fh.fileno())  # FINDING: blocking in a holds: helper
+
+    def ok_wait(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self.table, timeout=0.01)
+
+    def ok_lookups(self):
+        with self._lock:
+            name = ", ".join(sorted(self.table))
+            return self.table.get(name)
+
+    def ok_outside(self):
+        item = self.jobs.get(timeout=1.0)
+        with self._lock:
+            self.table[item] = True

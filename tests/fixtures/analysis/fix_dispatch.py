@@ -1,0 +1,41 @@
+"""acs-lint fixture: dispatch-half purity of evaluate_async.
+
+Expected findings:
+  * Kernel.evaluate_async:out_dev.block_until_ready
+  * Kernel.evaluate_async:np.asarray(out_dev)
+Not findings: the SAME calls inside the returned materialize() thunk
+(Clean.evaluate_async), np.asarray of something that is not a device
+result binding.
+"""
+
+import numpy as np
+
+
+class Kernel:
+    def evaluate_async(self, batch):
+        out_dev = self._dispatch(batch)
+        out_dev.block_until_ready()  # FINDING: sync in the dispatch half
+        host = np.asarray(out_dev)   # FINDING: D2H in the dispatch half
+
+        def materialize():
+            return host
+
+        return materialize
+
+    def _dispatch(self, batch):
+        return batch
+
+
+class Clean:
+    def evaluate_async(self, batch):
+        out_dev = self._dispatch(batch)
+        shape = np.asarray(batch.shape)  # ok: not a device-call binding
+
+        def materialize():
+            out_dev.block_until_ready()      # ok: materialize half
+            return np.asarray(out_dev), shape
+
+        return materialize
+
+    def _dispatch(self, batch):
+        return batch

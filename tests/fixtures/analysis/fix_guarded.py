@@ -1,0 +1,60 @@
+"""acs-lint fixture: guarded-by discipline — violations and exemptions.
+
+Expected findings (path, rule, symbol):
+  * Store.unlocked_read:self._data      (read outside the lock)
+  * Store.unlocked_write:self._data     (write outside the lock)
+  * Store.wrong_lock:self._data         (held a DIFFERENT lock)
+  * peek:_registry                      (module global outside the lock)
+Expected suppressions: 1 (Store.suppressed_read).
+Everything else is exempt: __init__ stores, with-lock access, holds:
+helper, condition wait_for lambda.
+"""
+
+import threading
+
+_registry = {}  # guarded-by: _registry_lock
+_registry_lock = threading.Lock()
+
+
+def peek():
+    return _registry.get("x")  # FINDING: global outside _registry_lock
+
+
+def register(key, value):
+    with _registry_lock:
+        _registry[key] = value  # ok: under the lock
+
+
+class Store:
+    def __init__(self):
+        self._data = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._other = threading.Lock()
+        self._cond = threading.Condition()
+        self.pending = []  # guarded-by: _cond
+
+    def unlocked_read(self):
+        return len(self._data)  # FINDING
+
+    def unlocked_write(self, k, v):
+        self._data[k] = v  # FINDING
+
+    def wrong_lock(self):
+        with self._other:
+            return dict(self._data)  # FINDING: _other is not _lock
+
+    def locked_ok(self, k):
+        with self._lock:
+            return self._data.get(k)
+
+    def _drain(self):  # holds: _lock
+        self._data.clear()  # ok: holds annotation
+
+    def suppressed_read(self):
+        # acs-lint: ignore[guarded-by] fixture: deliberate racy len
+        return len(self._data)
+
+    def wait_ok(self):
+        with self._cond:
+            self._cond.wait_for(lambda: bool(self.pending), timeout=0.01)
+            return list(self.pending)  # ok: lambda + body under _cond

@@ -1,0 +1,21 @@
+"""acs-lint fixture: self-declared host-only module importing jax.
+
+Expected findings:
+  * <module>:import jax          (top-level)
+  * lazy:import jax.numpy        (lazy import inside a function)
+"""
+
+# acs-lint: host-only
+
+import json  # noqa: F401 — ok
+import jax  # noqa: F401  # FINDING
+
+
+def lazy():
+    import jax.numpy as jnp  # noqa: F401  # FINDING: lazy import counts
+
+    return jnp
+
+
+def fine():
+    return json.dumps({})

@@ -1,0 +1,29 @@
+"""acs-lint fixture: thread lifecycle — every Thread daemonized or joined.
+
+Expected findings:
+  * leak:threading.Thread        (neither daemon nor joined)
+Not findings: daemon=True kwarg, assigned-then-joined,
+assigned-then-daemonized.
+"""
+
+import threading
+
+
+def leak(fn):
+    threading.Thread(target=fn).start()  # FINDING
+
+
+def ok_daemon(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def ok_joined(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    worker.join(timeout=1.0)
+
+
+def ok_daemonized_later(fn):
+    pump = threading.Thread(target=fn)
+    pump.daemon = True
+    pump.start()

@@ -1,0 +1,24 @@
+"""acs-lint fixture: wall-clock time.time() in timing logic.
+
+Expected findings:
+  * deadline_in:time.time
+Expected suppressions: 1 (uptime display).
+time.monotonic() is never flagged.
+"""
+
+import time
+
+_START = time.monotonic()
+
+
+def deadline_in(budget_s):
+    return time.time() + budget_s  # FINDING: deadline math on wall clock
+
+
+def elapsed():
+    return time.monotonic() - _START  # ok
+
+
+def uptime_display():
+    # acs-lint: ignore[wall-clock] fixture: human-facing display value
+    return time.time()

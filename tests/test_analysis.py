@@ -1,0 +1,277 @@
+"""acs-lint unit tests: each rule against its fixture module, baseline
+gate semantics (new / stale / unjustified), suppression accounting,
+idempotence, and the runtime lock-order detector's self-tests.
+
+The fixture tree (tests/fixtures/analysis/) is OUTSIDE the shipped scan
+root on purpose: its modules violate every rule by construction and must
+never leak into the package gate (tests/test_analysis_gate.py)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from access_control_srv_tpu.analysis import (
+    ALL_RULES,
+    run_analysis,
+)
+from access_control_srv_tpu.analysis import baseline as baseline_mod
+from access_control_srv_tpu.analysis.locktrace import (
+    LockOrderError,
+    lock_order_watch,
+)
+from access_control_srv_tpu.analysis.runner import main as lint_main
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+# the complete expected finding set for the fixture tree: (path, rule,
+# symbol) — line numbers are deliberately NOT part of finding identity
+EXPECTED = {
+    ("tests/fixtures/analysis/fix_blocking.py", "blocking-under-lock",
+     "Pump.stall:time.sleep"),
+    ("tests/fixtures/analysis/fix_blocking.py", "blocking-under-lock",
+     "Pump.drain:self.jobs.get"),
+    ("tests/fixtures/analysis/fix_blocking.py", "blocking-under-lock",
+     "Pump.flush:os.fsync"),
+    ("tests/fixtures/analysis/fix_dispatch.py", "dispatch-purity",
+     "Kernel.evaluate_async:block_until_ready"),
+    ("tests/fixtures/analysis/fix_dispatch.py", "dispatch-purity",
+     "Kernel.evaluate_async:np.asarray(out_dev)"),
+    ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+     "Store.unlocked_read:self._data"),
+    ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+     "Store.unlocked_write:self._data"),
+    ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+     "Store.wrong_lock:self._data"),
+    ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+     "peek:_registry"),
+    ("tests/fixtures/analysis/fix_hostonly.py", "host-only-jax",
+     "<module>:import jax"),
+    ("tests/fixtures/analysis/fix_hostonly.py", "host-only-jax",
+     "lazy:import jax.numpy"),
+    ("tests/fixtures/analysis/fix_threads.py", "thread-lifecycle",
+     "leak:Thread(<unassigned>)"),
+    ("tests/fixtures/analysis/fix_wallclock.py", "wall-clock",
+     "deadline_in:time.time"),
+}
+
+
+@pytest.fixture(scope="module")
+def fixture_report():
+    return run_analysis(FIXTURES)
+
+
+# --------------------------------------------------------------- findings
+
+
+def test_fixture_findings_exact(fixture_report):
+    """Every planted violation is found; nothing else is."""
+    assert {f.key for f in fixture_report.findings} == EXPECTED
+    assert not fixture_report.errors
+
+
+def test_every_rule_exercised(fixture_report):
+    assert {f.rule for f in fixture_report.findings} == set(ALL_RULES)
+
+
+def test_findings_carry_display_line_and_message(fixture_report):
+    for finding in fixture_report.findings:
+        assert finding.line > 0
+        assert finding.message
+
+
+def test_suppressions_counted_with_reasons(fixture_report):
+    sups = {(s.path, s.rule): s.reason
+            for s in fixture_report.suppressions}
+    assert ("tests/fixtures/analysis/fix_guarded.py",
+            "guarded-by") in sups
+    assert ("tests/fixtures/analysis/fix_wallclock.py",
+            "wall-clock") in sups
+    assert len(fixture_report.suppressions) == 2
+    for reason in sups.values():
+        assert reason.strip()
+
+
+def test_idempotent(fixture_report):
+    """Two runs over the same tree produce identical ordered findings."""
+    again = run_analysis(FIXTURES)
+    assert [f.key for f in again.findings] == \
+        [f.key for f in fixture_report.findings]
+    assert [(s.path, s.rule, s.symbol, s.line) for s in again.suppressions] \
+        == [(s.path, s.rule, s.symbol, s.line)
+            for s in fixture_report.suppressions]
+
+
+# ---------------------------------------------------------- baseline gate
+
+
+def _write_baseline(path: Path, keys, justification="accepted in test"):
+    path.write_text(json.dumps({
+        "version": 1,
+        "suppressions": [
+            {"path": p, "rule": r, "symbol": s,
+             "justification": justification}
+            for (p, r, s) in sorted(keys)
+        ],
+    }))
+
+
+def test_baseline_full_match_is_clean(tmp_path, fixture_report):
+    bl = tmp_path / "baseline.json"
+    _write_baseline(bl, EXPECTED)
+    report = run_analysis(FIXTURES, baseline=bl)
+    assert report.diff is not None
+    assert report.diff.clean and report.ok
+    assert report.diff.matched == len(EXPECTED)
+
+
+def test_new_finding_fails_gate(tmp_path):
+    bl = tmp_path / "baseline.json"
+    partial = sorted(EXPECTED)[:-1]
+    _write_baseline(bl, partial)
+    report = run_analysis(FIXTURES, baseline=bl)
+    assert not report.ok
+    assert [f.key for f in report.diff.new] == [sorted(EXPECTED)[-1]]
+
+
+def test_stale_entry_fails_gate(tmp_path):
+    """A baselined finding that no longer exists must fail the run —
+    a stale suppression can swallow a future regression."""
+    bl = tmp_path / "baseline.json"
+    ghost = ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+             "Store.fixed_long_ago:self._data")
+    _write_baseline(bl, set(EXPECTED) | {ghost})
+    report = run_analysis(FIXTURES, baseline=bl)
+    assert not report.ok
+    assert [e.key for e in report.diff.stale] == [ghost]
+
+
+def test_unjustified_entry_fails_gate(tmp_path):
+    bl = tmp_path / "baseline.json"
+    _write_baseline(bl, EXPECTED, justification="   ")
+    report = run_analysis(FIXTURES, baseline=bl)
+    assert not report.ok
+    assert len(report.diff.unjustified) == len(EXPECTED)
+
+
+def test_save_carries_justifications(tmp_path, fixture_report):
+    bl = tmp_path / "baseline.json"
+    key = sorted(EXPECTED)[0]
+    baseline_mod.save(bl, fixture_report.findings, {key: "why"})
+    entries = {e.key: e.justification for e in baseline_mod.load(bl)}
+    assert entries[key] == "why"
+    assert set(entries) == EXPECTED
+
+
+# ------------------------------------------------------------- CLI runner
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert lint_main(["--root", str(FIXTURES), "--no-baseline"]) == 1
+    bl = tmp_path / "baseline.json"
+    _write_baseline(bl, EXPECTED)
+    assert lint_main(
+        ["--root", str(FIXTURES), "--baseline", str(bl)]
+    ) == 0
+    ghost = ("tests/fixtures/analysis/fix_guarded.py", "guarded-by",
+             "Store.fixed_long_ago:self._data")
+    _write_baseline(bl, set(EXPECTED) | {ghost})
+    assert lint_main(
+        ["--root", str(FIXTURES), "--baseline", str(bl)]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "stale-baseline" in out
+
+
+def test_cli_json_report(capsys):
+    lint_main(["--root", str(FIXTURES), "--no-baseline", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is False
+    assert {tuple(f[k] for k in ("path", "rule", "symbol"))
+            for f in data["findings"]} == EXPECTED
+    assert data["by_rule"]["guarded-by"] == 4
+
+
+# ------------------------------------------------- runtime lock ordering
+
+
+def test_locktrace_detects_injected_inversion():
+    """A,B then B,A — sequentially, one thread — is already a conviction:
+    the orders happened, the deadlock merely hasn't been scheduled."""
+    with lock_order_watch() as watch:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    with pytest.raises(LockOrderError) as exc:
+        watch.assert_acyclic()
+    assert exc.value.cycle[0] == exc.value.cycle[-1]
+    assert len(exc.value.cycle) >= 3
+
+
+def test_locktrace_consistent_order_is_clean():
+    with lock_order_watch() as watch:
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.RLock()
+        for _ in range(3):
+            with a:
+                with b:
+                    with c:
+                        pass
+            with a:
+                with c:
+                    pass
+    watch.assert_acyclic()
+    assert watch.edges()  # the order graph was actually recorded
+
+
+def test_locktrace_reentrant_rlock_no_self_edge():
+    with lock_order_watch() as watch:
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+    watch.assert_acyclic()
+    assert not watch.edges()
+
+
+def test_locktrace_condition_compatible():
+    """Tracked locks serve as Condition underlying locks: wait_for
+    releases/restores through the private hooks, cross-thread."""
+    with lock_order_watch() as watch:
+        cond = threading.Condition(threading.Lock())
+        rcond = threading.Condition()  # default RLock, also tracked
+        released = []
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: bool(released), timeout=2.0)
+
+        thread = threading.Thread(target=waiter)
+        thread.start()
+        time.sleep(0.05)
+        with cond:
+            released.append(True)
+            cond.notify_all()
+        thread.join(timeout=2.0)
+        assert not thread.is_alive()
+        with rcond:
+            rcond.wait(timeout=0.01)
+    watch.assert_acyclic()
+
+
+def test_locktrace_uninstall_restores_factories():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with lock_order_watch():
+        assert threading.Lock is not orig_lock
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock

@@ -1,0 +1,102 @@
+"""srv/clock.py: monotonic-anchored wall clock, and the regression the
+module exists for — ResourceService metadata stamps must never go
+backward when the wall clock does (NTP slew, manual adjustment).
+
+Before the fix, ``ResourceService._create_metadata`` stamped
+``meta.modified``/``meta.created`` straight from ``time.time()``: a
+backward wall step between two MODIFYs produced ``modified`` values that
+DECREASE while document history advances, silently reordering history
+for replication reconciliation and any since-I-read-it client check."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from access_control_srv_tpu.core.engine import AccessController
+from access_control_srv_tpu.srv import clock as clock_mod
+from access_control_srv_tpu.srv import store as store_mod
+from access_control_srv_tpu.srv.clock import monotonic_wall
+from access_control_srv_tpu.srv.store import PolicyStore
+
+
+# ---------------------------------------------------------- monotonic_wall
+
+
+def test_monotonic_wall_reads_as_epoch_seconds():
+    assert abs(monotonic_wall() - time.time()) < 5.0
+
+
+def test_monotonic_wall_never_decreases():
+    last = monotonic_wall()
+    for _ in range(1000):
+        now = monotonic_wall()
+        assert now >= last
+        last = now
+
+
+def test_monotonic_wall_immune_to_wall_steps(monkeypatch):
+    """Stepping the wall clock (as NTP would) must not move the value:
+    only the monotonic term advances after the import-time anchor."""
+    before = monotonic_wall()
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() - 3600.0)
+    during = monotonic_wall()
+    assert during >= before
+    assert during - before < 5.0  # the -1h step did NOT leak through
+
+
+# ----------------------------------------------- metadata stamp regression
+
+
+@pytest.fixture()
+def rule_service():
+    store = PolicyStore(AccessController())
+    return store.services["rule"]
+
+
+def test_modified_stamp_survives_backward_wall_step(
+    rule_service, monkeypatch
+):
+    """MODIFY after a backward wall step: the new ``modified`` stamp must
+    not precede the previous one (regression for the time.time() stamp)."""
+    doc = {"id": "r-clock", "effect": "PERMIT"}
+    first = rule_service._create_metadata([dict(doc)], "CREATE", None)[0]
+    t_first = first["meta"]["modified"]
+
+    # the wall clock steps back one hour between the two mutations
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() - 3600.0)
+    second = rule_service._create_metadata([dict(doc)], "MODIFY", None)[0]
+    t_second = second["meta"]["modified"]
+
+    assert t_second >= t_first, (
+        f"modified went backward across a wall step: {t_first} -> "
+        f"{t_second} — document history reordered"
+    )
+
+
+def test_created_preserved_and_epoch_like(rule_service):
+    """created falls out of the monotonic-anchored clock but still reads
+    as a plausible Unix epoch stamp (wire compatibility)."""
+    [item] = rule_service._create_metadata(
+        [{"id": "r-epoch", "effect": "PERMIT"}], "CREATE", None
+    )
+    created = item["meta"]["created"]
+    assert abs(created - time.time()) < 60.0
+    assert item["meta"]["modified"] >= created
+
+
+def test_store_module_uses_blessed_clock():
+    """The stamp path imports monotonic_wall; raw time.time() must not
+    return (acs-lint's wall-clock rule enforces this tree-wide, this is
+    the targeted guard)."""
+    import inspect
+
+    src = inspect.getsource(store_mod.ResourceService._create_metadata)
+    assert "now = monotonic_wall()" in src
+    code_lines = [ln.split("#", 1)[0] for ln in src.splitlines()]
+    assert not any("time.time()" in ln for ln in code_lines)
+    # and the clock module carries the single blessed wall read
+    assert "time.time()" in inspect.getsource(clock_mod)

@@ -238,3 +238,85 @@ def test_restarted_replica_serves_correct_decisions(tmp_path):
     finally:
         channel.close()
         cluster.stop()
+
+
+# -------------------------------------------------- lock-order soak
+
+
+@pytest.mark.slow
+@pytest.mark.cluster(timeout=180)
+def test_no_lock_order_cycles_in_router_under_chaos(tmp_path):
+    """Runtime lock-order detection over the chaos tier's IN-PROCESS
+    surface — the ClusterRouter, its health loop, and the SocketEventBus
+    client — while a replica is killed and restarted mid-churn.  Replica
+    subprocesses are out of scope by construction (the watch patches this
+    process's lock factories); the router is where cross-thread lock
+    nesting lives on this tier, and a cycle in its acquisition graph is a
+    deadlock the scheduler merely hasn't dealt yet.  See
+    access_control_srv_tpu/analysis/locktrace.py."""
+    from access_control_srv_tpu.analysis.locktrace import lock_order_watch
+
+    with lock_order_watch() as watch:
+        cluster = LocalCluster(
+            n_replicas=2,
+            seed_cfg=seed_paths(),
+            router_cfg={"health_interval_s": 0.2, "max_retries": 1},
+            base_dir=str(tmp_path),
+        ).start()
+        channel = grpc.insecure_channel(cluster.router.addr)
+        try:
+            create_reader_policy_tree(channel, RULE_ID)
+            wait_converged(
+                [r.addr for r in cluster.replicas], timeout_s=30.0,
+                min_epoch=1,
+            )
+            is_allowed = channel.unary_unary(
+                "/acstpu.AccessControlService/IsAllowed",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.Response.FromString,
+            )
+            stop = threading.Event()
+
+            def client_loop():
+                msg = wire_request(role="reader-role")
+                while not stop.is_set():
+                    try:
+                        is_allowed(msg, timeout=10)
+                    except grpc.RpcError:
+                        pass
+                    time.sleep(0.004)
+
+            def churn_loop():
+                flip = 0
+                while not stop.is_set():
+                    flip += 1
+                    effect = "PERMIT" if flip % 2 else "DENY"
+                    try:
+                        upsert_rule(
+                            channel,
+                            reader_rule_doc(RULE_ID, effect=effect),
+                        )
+                    except grpc.RpcError:
+                        pass
+                    time.sleep(0.1)
+
+            threads = [threading.Thread(target=client_loop, daemon=True)
+                       for _ in range(2)]
+            threads.append(
+                threading.Thread(target=churn_loop, daemon=True)
+            )
+            for thread in threads:
+                thread.start()
+            time.sleep(1.0)
+            cluster.replicas[1].kill()      # health loop must notice
+            time.sleep(1.5)
+            cluster.restart_replica(1)      # ...and re-admit
+            time.sleep(1.0)
+            stop.set()
+            for thread in threads:
+                thread.join(timeout=15)
+                assert not thread.is_alive()
+        finally:
+            channel.close()
+            cluster.stop()
+    watch.assert_acyclic()

@@ -670,3 +670,70 @@ class TestChurnMidPipeline:
             client.close()
             server.stop()
             worker.stop()
+
+
+# -------------------------------------------------- lock-order soak
+
+
+@pytest.mark.slow
+class TestLockOrderUnderPipelineSoak:
+    """Runtime complement of acs-lint's static lock discipline (see
+    access_control_srv_tpu/analysis/locktrace.py): every Lock/RLock the
+    serving stack CREATES during the soak is tracked, each acquisition
+    with locks held records a held->acquiring edge, and a cycle in that
+    graph is a deadlock the scheduler merely hasn't dealt yet."""
+
+    def test_no_lock_order_cycles_in_churned_pipeline(self):
+        from access_control_srv_tpu.analysis.locktrace import (
+            lock_order_watch,
+        )
+
+        with lock_order_watch() as watch:
+            worker = Worker().start(pipe_cfg(4, admission=True))
+            rule_service = worker.store.get_resource_service("rule")
+            stop_churn = threading.Event()
+
+            def churn():
+                flip = 0
+                while not stop_churn.is_set():
+                    flip += 1
+                    rule_service.update([{
+                        "id": "super_admin_rule",
+                        "name": f"lockorder-churn-{flip}",
+                        "target": {
+                            "subjects": [{
+                                "id": URNS["role"],
+                                "value": "superadministrator-r-id",
+                            }],
+                            "resources": [{"id": URNS["entity"],
+                                           "value": ORG}],
+                            "actions": [{"id": URNS["actionID"],
+                                         "value": URNS["read"]}],
+                        },
+                        "effect": "PERMIT" if flip % 2 else "DENY",
+                    }])
+                    time.sleep(0.01)
+
+            churner = threading.Thread(target=churn, daemon=True)
+            try:
+                churner.start()
+
+                def serve(seed):
+                    for frame in range(20):
+                        worker.service.is_allowed_batch([
+                            mixed_request(seed * 31 + frame * 7 + i)
+                            for i in range(16)
+                        ])
+
+                with ThreadPoolExecutor(max_workers=6) as pool:
+                    futures = [pool.submit(serve, n) for n in range(6)]
+                    for future in futures:
+                        future.result(timeout=120)
+            finally:
+                stop_churn.set()
+                churner.join(timeout=5)
+                worker.stop()
+        watch.assert_acyclic()
+        # the soak must have exercised real nested acquisitions — an
+        # empty graph would mean the watch missed the system entirely
+        assert watch.edges(), "no lock-order edges recorded during soak"

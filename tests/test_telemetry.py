@@ -390,3 +390,59 @@ def test_worker_config_wires_json_sink(tmp_path):
             worker.logger.removeHandler(h)
     lines = [json.loads(ln) for ln in sink.read_text().splitlines()]
     assert any(ln.get("probe") for ln in lines)
+
+
+def test_stages_view_is_detached_snapshot():
+    """The histogram_group for per-stage durations must hand render() a
+    COPY of the stage map: iterating the live dict while stage_histogram
+    lazily inserts a new stage raises ``dict changed size during
+    iteration`` mid-scrape (regression for the registered live-dict fn)."""
+    t = Telemetry()
+    t.stage_histogram("encode").observe(0.001)
+    view = t._stages_view()
+    assert view is not t.stages
+    # a late-bound stage appears in the live map but not the taken view
+    t.stage_histogram("dispatch").observe(0.002)
+    assert "dispatch" in t.stages and "dispatch" not in view
+    # the NEXT render does see it (late-bound members appear at scrape)
+    body = t.prometheus()
+    assert 'acs_stage_duration_seconds_count{stage="dispatch"} 1' in body
+    assert 'acs_stage_duration_seconds_count{stage="encode"} 1' in body
+
+
+def test_prometheus_render_survives_stage_insertions():
+    """Scrape concurrently with lazy stage creation: before _stages_view
+    the group fn returned the live dict and render() died with
+    RuntimeError('dict changed size during iteration')."""
+    import threading as _threading
+
+    t = Telemetry()
+    stop = _threading.Event()
+    errors = []
+
+    def inserter():
+        i = 0
+        while not stop.is_set():
+            t.stage_histogram(f"stage-{i}").observe(0.0001)
+            i += 1
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                t.prometheus()
+            except RuntimeError as err:
+                errors.append(err)
+                return
+
+    threads = [_threading.Thread(target=inserter),
+               _threading.Thread(target=scraper),
+               _threading.Thread(target=scraper)]
+    for thread in threads:
+        thread.start()
+    import time as _time
+
+    _time.sleep(0.4)
+    stop.set()
+    for thread in threads:
+        thread.join()
+    assert not errors, errors

@@ -862,6 +862,38 @@ def main() -> int:
                  "program-identity invariant (docs/CLUSTER.md)"),
     })
 
+    # ---- static-invariants-clean: acs-lint gate over the shipped tree.
+    # The audit's host-only rows (tracing/admission-zero-device-ops)
+    # prove specific modules import no device runtime; this row proves
+    # the claim tree-wide and machine-checked — the full analyzer
+    # (guarded-by, blocking-under-lock, wall-clock, host-only-jax,
+    # thread-lifecycle, dispatch-purity) over the package is clean
+    # against the checked-in baseline, every baselined finding justified.
+    from access_control_srv_tpu.analysis import (
+        DEFAULT_BASELINE,
+        PACKAGE_ROOT,
+        run_analysis,
+    )
+
+    lint = run_analysis(PACKAGE_ROOT, baseline=DEFAULT_BASELINE)
+    lint_diff = lint.diff
+    results.append({
+        "kernel": "static-invariants-clean",
+        "ok": bool(lint.ok and not lint.errors),
+        "modules_analyzed": lint.modules,
+        "findings_baselined": lint_diff.matched if lint_diff else 0,
+        "new_findings": [list(f.key) for f in lint_diff.new]
+        if lint_diff else [],
+        "stale_baseline": [list(e.key) for e in lint_diff.stale]
+        if lint_diff else [],
+        "note": ("acs-lint (python -m access_control_srv_tpu.analysis) "
+                 "is clean over the shipped package: no unbaselined "
+                 "lock-discipline, blocking-under-lock, wall-clock, "
+                 "host-only-jax, thread-lifecycle, or dispatch-purity "
+                 "findings, no stale or unjustified baseline entries "
+                 "(docs/ANALYSIS.md)"),
+    })
+
     verdict = {
         "backend": backend,
         "device": str(jax.devices()[0]),
