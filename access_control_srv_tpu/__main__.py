@@ -58,6 +58,12 @@ def main(argv: list[str] | None = None) -> int:
              "semantics — a host crash can drop the flushed tail",
     )
     parser.add_argument(
+        "--broker-snapshot-every", default=None, type=int,
+        help="broker durability: take a crash-consistent snapshot and "
+             "truncate the journal behind it every N journal records "
+             "(default: never — replay walks the full journal)",
+    )
+    parser.add_argument(
         "--router", action="store_true",
         help="serve a cluster router (srv/router.py) over running "
              "replicas instead of a worker",
@@ -102,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
             data_dir=args.broker_data_dir,
             secret=args.broker_secret,
             fsync_interval_s=args.broker_fsync_interval,
+            snapshot_every=args.broker_snapshot_every,
         ).start()
         print(f"broker listening on {broker.address}", flush=True)
         stop_event.wait()

@@ -1087,6 +1087,13 @@ class DecisionKernel:
         compile, which would stall a micro-batched serving path on nearly
         every call (the distinct-entity axis of the regex matrices is
         bucketed for the same reason)."""
+        # failpoints (srv/faults.py): host-side only — fired before the
+        # jitted call / inside the materialize thunk, so the lowered
+        # device program is byte-identical with faults configured
+        # (tpu_compat_audit.py failpoints-zero-device-ops)
+        from ..srv.faults import REGISTRY as _faults
+
+        _faults.fire("device.dispatch")
         b, bucket, e_bucket, pad_lead = lead_padding(batch)
 
         # dispatch on ACL content: only batches actually carrying ACL
@@ -1105,4 +1112,10 @@ class DecisionKernel:
             jnp.asarray(pad_cols(batch.cond_abort, bucket)),
             jnp.asarray(pad_cols(batch.cond_code, bucket)),
         )
-        return lambda: tuple(np.asarray(x)[:b] for x in out)
+        def materialize():
+            # hang here models a wedged D2H fetch — the watchdog
+            # (srv/watchdog.py) bounds it on the serving pipeline
+            _faults.fire("device.materialize")
+            return tuple(np.asarray(x)[:b] for x in out)
+
+        return materialize

@@ -802,6 +802,12 @@ class PrefilteredKernel:
             # small trees: the dense/sharded kernel's own async dispatch
             return self._dense.evaluate_async(batch)
 
+        # failpoint (srv/faults.py): host-side dispatch boundary — fires
+        # before any device work, so the lowered program is unchanged
+        from ..srv.faults import REGISTRY as _faults
+
+        _faults.fire("device.dispatch")
+
         ents = np.asarray(batch.arrays["r_ent_vals"])  # [B, NR]
         cols = np.asarray(batch.arrays["r_ent_e"])     # [B, NR]
         valid = np.asarray(batch.arrays["r_ent_valid"])
@@ -896,7 +902,12 @@ class PrefilteredKernel:
                 for o, s in zip(outs, seg_out):
                     o[idx] = s
             res = tuple(outs)
-            return lambda: res
+
+            def materialize():
+                _faults.fire("device.materialize")
+                return res
+
+            return materialize
 
         # entity value id -> batch entity column (positional in the runs)
         id_to_col = dict(zip(ents[valid].tolist(), cols[valid].tolist()))
@@ -1142,6 +1153,7 @@ class PrefilteredKernel:
                 # inputs, so the staging leases are safe to recycle only
                 # AFTER this line — releasing earlier could leak rows
                 # between batches on the zero-copy CPU backend
+                _faults.fire("device.materialize")
                 out = np.asarray(out_dev)  # [3, b_pad]
                 if leases:
                     pool.release_all(leases)
@@ -1164,4 +1176,8 @@ class PrefilteredKernel:
             jnp.asarray(pad_cols(batch.cond_abort, bucket)),
             jnp.asarray(pad_cols(batch.cond_code, bucket)),
         )
-        return lambda: tuple(np.asarray(x)[:B] for x in out)
+        def materialize():
+            _faults.fire("device.materialize")
+            return tuple(np.asarray(x)[:B] for x in out)
+
+        return materialize

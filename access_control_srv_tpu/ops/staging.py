@@ -58,6 +58,12 @@ class HostBufferPool:
         return (tuple(shape), np.dtype(dtype).str)
 
     def acquire(self, shape, dtype=np.int32) -> np.ndarray:
+        # failpoint (srv/faults.py): staging exhaustion / allocator
+        # stall — error fails the encode (callers fall back to the pb
+        # path), delay models allocator pressure
+        from ..srv.faults import REGISTRY as _faults
+
+        _faults.fire("staging.acquire")
         key = self._key(shape, dtype)
         with self._lock:
             free = self._free.get(key)

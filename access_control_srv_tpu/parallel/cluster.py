@@ -70,6 +70,11 @@ def _spawn(args: list[str], ready_prefix: str, timeout_s: float,
     """Start a CLI subprocess and wait for its ``ready_prefix`` stdout
     line; returns (process, address).  A drain thread keeps consuming
     stdout afterwards so the pipe never backpressures the child."""
+    # failpoint (srv/faults.py): replica/broker spawn — error models a
+    # scheduler refusing the placement, delay a slow cold boot
+    from ..srv.faults import REGISTRY as _faults
+
+    _faults.fire("cluster.spawn")
     proc = subprocess.Popen(
         args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, cwd=cwd, env=env,
@@ -170,7 +175,8 @@ class LocalCluster:
                  cfg_extra: dict | None = None,
                  router_cfg: dict | None = None,
                  base_dir: str | None = None,
-                 replica_timeout_s: float = 120.0):
+                 replica_timeout_s: float = 120.0,
+                 broker_snapshot_every: int | None = None):
         self.n_replicas = int(n_replicas)
         self.seed_cfg = seed_cfg or {}
         self.cfg_extra = cfg_extra or {}
@@ -178,6 +184,10 @@ class LocalCluster:
         self._own_base = base_dir is None
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="acs-cluster-")
         self.replica_timeout_s = replica_timeout_s
+        # snapshot + journal-compaction cadence (srv/broker.py): None
+        # keeps full-journal replay; chaos tests reuse base_dir across
+        # stop/start so a rebooted cluster recovers from snapshot + tail
+        self.broker_snapshot_every = broker_snapshot_every
         self.broker_proc: Optional[subprocess.Popen] = None
         self.broker_addr: Optional[str] = None
         self.replicas: list[ReplicaProcess] = []
@@ -190,12 +200,20 @@ class LocalCluster:
             os.path.abspath(__file__))))
         broker_dir = os.path.join(self.base_dir, "broker")
         os.makedirs(broker_dir, exist_ok=True)
+        broker_args = [
+            sys.executable, "-m", "access_control_srv_tpu", "--broker",
+            "--addr", "127.0.0.1:0", "--broker-data-dir", broker_dir,
+        ]
+        if self.broker_snapshot_every is not None:
+            broker_args += [
+                "--broker-snapshot-every", str(self.broker_snapshot_every)
+            ]
         self.broker_proc, self.broker_addr = _spawn(
-            [sys.executable, "-m", "access_control_srv_tpu", "--broker",
-             "--addr", "127.0.0.1:0", "--broker-data-dir", broker_dir],
-            "broker listening on ", 30.0, cwd=repo_root,
+            broker_args, "broker listening on ", 30.0, cwd=repo_root,
         )
-        if self.seed_cfg:
+        # reused base_dir (chaos reboot): the journal/snapshot already
+        # hold the policy state — re-seeding would double every frame
+        if self.seed_cfg and not self._journal_populated(broker_dir):
             self._seed_journal()
         for i in range(self.n_replicas):
             self.replicas.append(
@@ -209,6 +227,20 @@ class LocalCluster:
             [r.addr for r in self.replicas], cfg=self.router_cfg,
         ).start()
         return self
+
+    @staticmethod
+    def _journal_populated(broker_dir: str) -> bool:
+        """True when the broker dir already carries durable state (a
+        non-empty journal or a snapshot) — i.e. this start() is a reboot
+        over an existing base_dir, not a first boot."""
+        journal = os.path.join(broker_dir, "broker.journal")
+        snapshot = os.path.join(broker_dir, "broker.snapshot")
+        if os.path.exists(snapshot):
+            return True
+        try:
+            return os.path.getsize(journal) > 0
+        except OSError:
+            return False
 
     def _seed_journal(self) -> None:
         """Write the seed YAMLs into the broker's journaled CRUD topics

@@ -133,6 +133,11 @@ class ShardedDecisionKernel:
     def evaluate_async(self, batch: RequestBatch):
         """Dispatch without blocking; returns the materialize callable
         (the data-parallel leg of the depth-N serving pipeline)."""
+        # failpoint (srv/faults.py): host-side dispatch boundary — fires
+        # before any device work, so the lowered program is unchanged
+        from ..srv.faults import REGISTRY as _faults
+
+        _faults.fire("device.dispatch")
         arrays = dict(batch.arrays)
         arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
         arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
@@ -146,4 +151,8 @@ class ShardedDecisionKernel:
             jnp.asarray(batch.rgx_set),
             jnp.asarray(batch.pfx_neq),
         )
-        return lambda: tuple(np.asarray(x)[: batch.B] for x in out)
+        def materialize():
+            _faults.fire("device.materialize")
+            return tuple(np.asarray(x)[: batch.B] for x in out)
+
+        return materialize

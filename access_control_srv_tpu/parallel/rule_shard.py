@@ -326,6 +326,11 @@ class RuleShardedKernel:
         scheme as DecisionKernel.evaluate, so serving traffic with varying
         batch sizes reuses a handful of compiled programs instead of
         triggering a fresh XLA compile per distinct size."""
+        # failpoint (srv/faults.py): host-side dispatch boundary — fires
+        # before any device work, so the lowered program is unchanged
+        from ..srv.faults import REGISTRY as _faults
+
+        _faults.fire("device.dispatch")
         arrays = dict(batch.arrays)
         arrays["cond_true"] = np.ascontiguousarray(batch.cond_true.T)
         arrays["cond_abort"] = np.ascontiguousarray(batch.cond_abort.T)
@@ -349,4 +354,8 @@ class RuleShardedKernel:
             jnp.asarray(pad_cols(batch.rgx_set, e_bucket)),
             jnp.asarray(pad_cols(batch.pfx_neq, e_bucket)),
         )
-        return lambda: tuple(np.asarray(x)[: batch.B] for x in out)
+        def materialize():
+            _faults.fire("device.materialize")
+            return tuple(np.asarray(x)[: batch.B] for x in out)
+
+        return materialize

@@ -249,6 +249,17 @@ class GraphQLAdapter(ResourceAdapter):
                 503, "context-query circuit open"
             )
         try:
+            # failpoint (srv/faults.py): an injected flap travels the
+            # exact transport-error path — breaker bookkeeping, retry
+            # with backoff, per-row degraded resolution
+            from .faults import REGISTRY as FAULTS
+
+            FAULTS.fire(
+                "adapter.http",
+                exc=lambda: ContextQueryTransportError(
+                    599, "fault injected at adapter.http"
+                ),
+            )
             data = self.transport(self.url, body, headers)
         except ContextQueryTransportError as err:
             if breaker is not None:

@@ -59,6 +59,7 @@ from ..models.model import Decision, OperationStatus, Response
 OVERLOAD_CODE = 429   # queue full / deadline-infeasible at submit
 DEADLINE_CODE = 504   # deadline expired before evaluation (dropped at dispatch)
 SHUTDOWN_CODE = 503   # still queued when the drain deadline hit
+DEGRADED_CODE = 503   # device path quarantined and no honest fallback ran
 
 INTERACTIVE = "interactive"
 BULK = "bulk"
@@ -85,6 +86,21 @@ def overload_response(code: int, message: str) -> Response:
         obligations=[],
         evaluation_cacheable=False,
         operation_status=OperationStatus(code=code, message=message),
+    )
+
+
+def degraded_response(message: str = "") -> Response:
+    """Honest INDETERMINATE for rows the quarantined device path could
+    not evaluate and no oracle fallback could absorb.  Distinct from the
+    shed envelope: ``degraded`` in the message names the cause as a
+    device-health event, not load.  Never cacheable, never a fabricated
+    PERMIT/DENY."""
+    detail = f"degraded: {message}" if message else "degraded"
+    return Response(
+        decision=Decision.INDETERMINATE,
+        obligations=[],
+        evaluation_cacheable=False,
+        operation_status=OperationStatus(code=DEGRADED_CODE, message=detail),
     )
 
 

@@ -33,12 +33,47 @@ import queue
 import socket
 import socketserver
 import threading
+import time
+import zlib
 from typing import Any, Callable, Optional
+
+from .faults import REGISTRY as FAULTS
 
 
 # subscription-stream liveness: how often an idle stream emits a
 # heartbeat frame (and thereby notices a dead peer)
 HEARTBEAT_INTERVAL = 5.0
+
+# journal line format: "C<crc32 hex8> <json>" — the CRC covers the JSON
+# text, so a torn append OR a flipped byte anywhere in the file fails
+# closed at replay (the consistent prefix is kept, the rest dropped).
+# Bare-JSON lines (pre-CRC journals, seeded journals) replay unchanged.
+_CRC_PREFIX_LEN = 10  # "C" + 8 hex + " "
+
+
+def _journal_line(rec: dict) -> str:
+    body = json.dumps(rec)
+    return f"C{zlib.crc32(body.encode()) & 0xFFFFFFFF:08x} {body}\n"
+
+
+def _decode_journal_line(line: str) -> Optional[dict]:
+    """One journal line -> record dict, or None when torn/corrupt."""
+    if line.startswith("C") and len(line) > _CRC_PREFIX_LEN \
+            and line[_CRC_PREFIX_LEN - 1] == " ":
+        try:
+            want = int(line[1:_CRC_PREFIX_LEN - 1], 16)
+        except ValueError:
+            return None
+        body = line[_CRC_PREFIX_LEN:]
+        if zlib.crc32(body.encode()) & 0xFFFFFFFF != want:
+            return None
+    else:
+        body = line
+    try:
+        rec = json.loads(body)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
 
 
 def _send(wfile, obj: dict) -> None:
@@ -76,7 +111,8 @@ class BrokerServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  data_dir: Optional[str] = None,
                  secret: Optional[str] = None,
-                 fsync_interval_s: Optional[float] = None):
+                 fsync_interval_s: Optional[float] = None,
+                 snapshot_every: Optional[int] = None):
         if host not in ("127.0.0.1", "localhost", "::1"):
             import sys as _sys
 
@@ -97,12 +133,27 @@ class BrokerServer:
             None if fsync_interval_s is None else float(fsync_interval_s)
         )
         self._last_fsync = 0.0  # guarded-by: _lock
+        # snapshot + compaction (docs/FAULTS.md): every `snapshot_every`
+        # journaled records the full topic/KV/offset state is written
+        # crash-consistently (temp + fsync + rename) at an offset
+        # watermark and the journal truncated behind it, so boot-by-
+        # replay cost is bounded regardless of churn history.
+        self._data_dir = data_dir
+        self.snapshot_every = (
+            None if not snapshot_every else int(snapshot_every)
+        )
+        self._watermark = 0       # guarded-by: _lock — records in snapshot
+        self._tail_records = 0    # guarded-by: _lock — records since it
+        self._snapshot_taken: Optional[float] = None  # guarded-by: _lock
+        self.recovered: Optional[dict] = None  # journal-truncation report
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
-            path = os.path.join(data_dir, "broker.journal")
-            if os.path.exists(path):
-                self._replay_journal(path)
-            self._journal = open(path, "a", encoding="utf-8")
+            self._journal_path = os.path.join(data_dir, "broker.journal")
+            self._snapshot_path = os.path.join(data_dir, "broker.snapshot")
+            self._load_snapshot()
+            if os.path.exists(self._journal_path):
+                self._replay_journal(self._journal_path)
+            self._journal = open(self._journal_path, "a", encoding="utf-8")
         broker = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -159,45 +210,172 @@ class BrokerServer:
 
     # ----------------------------------------------------------- durability
     # holds: _lock (trivially exclusive: runs in __init__ before the server thread starts)
+    def _load_snapshot(self) -> None:
+        """Restore topic/KV/offset state from the snapshot file (the
+        boot base the journal tail replays on top of).  A corrupt
+        snapshot fails closed: ignored, boot falls back to whatever the
+        journal holds."""
+        path = self._snapshot_path
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                blob = json.load(fh)
+            state_json = blob["state"]
+            if zlib.crc32(state_json.encode()) & 0xFFFFFFFF != blob["crc"]:
+                raise ValueError("snapshot CRC mismatch")
+            state = json.loads(state_json)
+        except (OSError, ValueError, KeyError, TypeError) as err:
+            self.recovered = {"snapshot_error": repr(err)}
+            return
+        self._topics = {
+            t: [(e, m) for e, m in log]
+            for t, log in state.get("topics", {}).items()
+        }
+        self._kv = dict(state.get("kv", {}))
+        self._consumer_offsets = dict(state.get("consumer_offsets", {}))
+        self._watermark = int(state.get("watermark", 0))
+        self._snapshot_taken = time.monotonic()
+
+    # holds: _lock (trivially exclusive: runs in __init__ before the server thread starts)
     def _replay_journal(self, path: str) -> None:
-        """Rebuild topics / KV / consumer offsets from the journal; a torn
-        trailing record (crash mid-append) is skipped."""
+        """Rebuild state from the journal tail (on top of any snapshot).
+        The first torn or CRC-corrupt record ends the replay — the
+        consistent prefix is kept and the file is truncated there, so a
+        crash mid-append or a flipped byte mid-file can never be
+        followed by silently re-ordered state."""
+        truncate_at: Optional[int] = None
+        offset = 0
         with open(path, "r", encoding="utf-8") as fh:
             for line in fh:
-                line = line.strip()
-                if not line:
+                line_len = len(line.encode("utf-8"))
+                stripped = line.strip()
+                if not stripped:
+                    offset += line_len
                     continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail record
-                kind = rec.get("k")
-                if kind == "emit":
-                    self._topics.setdefault(rec["t"], []).append(
-                        (rec["e"], rec.get("m"))
-                    )
-                elif kind == "set":
-                    self._kv[rec["key"]] = rec.get("v")
-                elif kind == "evict":
-                    for key in [
-                        k for k in self._kv if k.startswith(rec["p"])
-                    ]:
-                        del self._kv[key]
-                elif kind == "co":
-                    self._consumer_offsets[rec["t"]] = rec["o"]
+                rec = _decode_journal_line(stripped)
+                if rec is None or not line.endswith("\n"):
+                    truncate_at = offset
+                    break
+                offset += line_len
+                self._apply_record(rec)
+                self._tail_records += 1
+        if truncate_at is not None:
+            size = os.path.getsize(path)
+            self.recovered = {
+                "truncated_at": truncate_at,
+                "dropped_bytes": size - truncate_at,
+            }
+            with open(path, "r+", encoding="utf-8") as fh:
+                fh.truncate(truncate_at)
+
+    def _apply_record(self, rec: dict) -> None:  # holds: _lock
+        kind = rec.get("k")
+        if kind == "emit":
+            self._topics.setdefault(rec["t"], []).append(
+                (rec["e"], rec.get("m"))
+            )
+        elif kind == "set":
+            self._kv[rec["key"]] = rec.get("v")
+        elif kind == "evict":
+            for key in [
+                k for k in self._kv if k.startswith(rec["p"])
+            ]:
+                del self._kv[key]
+        elif kind == "co":
+            self._consumer_offsets[rec["t"]] = rec["o"]
 
     def _log(self, rec: dict) -> None:  # holds: _lock
         """Append one journal record; caller holds self._lock."""
         if self._journal is not None:
-            self._journal.write(json.dumps(rec) + "\n")
+            payload = _journal_line(rec)
+            # failpoints (srv/faults.py): torn truncates the append
+            # mid-record (replay CRC catches it); error/delay/hang act
+            # as a failing/slow disk
+            payload = FAULTS.tear("broker.journal.write", payload)
+            self._journal.write(payload)
             self._journal.flush()
+            self._tail_records += 1
             if self.fsync_interval_s is not None:
-                import time as _time
-
-                now = _time.monotonic()
+                now = time.monotonic()
                 if now - self._last_fsync >= self.fsync_interval_s:
+                    FAULTS.fire("broker.journal.fsync")
                     os.fsync(self._journal.fileno())
                     self._last_fsync = now
+            if (self.snapshot_every is not None
+                    and self._tail_records >= self.snapshot_every):
+                self._snapshot_locked()
+
+    def _snapshot_locked(self) -> None:  # holds: _lock
+        """Crash-consistent snapshot at the current offset watermark:
+        serialize full state, temp + fsync + rename, fsync the
+        directory, then truncate the journal behind it.  A crash at ANY
+        point leaves either (old snapshot + full journal) or (new
+        snapshot + empty-or-newer journal) — never a torn mix."""
+        if self._journal is None:
+            return
+        state = {
+            "watermark": self._watermark + self._tail_records,
+            "topics": {
+                t: [[e, m] for e, m in log]
+                for t, log in self._topics.items()
+            },
+            "kv": self._kv,
+            "consumer_offsets": self._consumer_offsets,
+        }
+        state_json = json.dumps(state, separators=(",", ":"))
+        blob = json.dumps({
+            "version": 1,
+            "crc": zlib.crc32(state_json.encode()) & 0xFFFFFFFF,
+            "state": state_json,
+        })
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(blob)
+            fh.flush()
+            # acs-lint: ignore[blocking-under-lock] snapshot atomicity: the
+            # journal must stay frozen across the durability point, same
+            # trade as the journal fsync itself
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._snapshot_path)
+        try:
+            dir_fd = os.open(self._data_dir, os.O_RDONLY)
+            try:
+                # acs-lint: ignore[blocking-under-lock] see temp-file fsync
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        except OSError:
+            pass  # non-POSIX dir-fsync: rename durability is best-effort
+        # compaction: the journal restarts empty behind the snapshot
+        self._journal.close()
+        self._journal = open(self._journal_path, "w", encoding="utf-8")
+        self._watermark = state["watermark"]
+        self._tail_records = 0
+        self._last_fsync = 0.0
+        self._snapshot_taken = time.monotonic()
+
+    def snapshot_now(self) -> dict:
+        """Force a snapshot (command surface + tests); returns status."""
+        with self._lock:
+            if self._journal is not None:
+                self._snapshot_locked()
+        return self.snapshot_status()
+
+    def snapshot_status(self) -> dict:
+        with self._lock:
+            taken = self._snapshot_taken
+            return {
+                "exists": bool(
+                    self._data_dir
+                    and os.path.exists(self._snapshot_path)
+                ),
+                "watermark": self._watermark,
+                "tail_records": self._tail_records,
+                "age_s": (None if taken is None
+                          else time.monotonic() - taken),
+                "recovered": self.recovered,
+            }
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, cmd: dict) -> dict:
@@ -255,6 +433,10 @@ class BrokerServer:
         if op == "offset_get":
             with self._lock:
                 return {"offset": self._consumer_offsets.get(cmd["topic"])}
+        if op == "snapshot_status":
+            return self.snapshot_status()
+        if op == "snapshot":
+            return self.snapshot_now()
         return {"error": f"unknown op {op!r}"}
 
     def _serve_subscription(self, handler, cmd: dict) -> None:
@@ -414,6 +596,15 @@ class SocketTopic:
                         frame = json.loads(line)
                         if "hb" in frame:  # liveness probe, not an event
                             continue
+                        # failpoint: a dropped/slow subscription — error
+                        # takes the exact reconnect path a real torn
+                        # connection would (OSError below)
+                        FAULTS.fire(
+                            "broker.topic.pump",
+                            exc=lambda: OSError(
+                                "fault injected at broker.topic.pump"
+                            ),
+                        )
                         listener(
                             frame["event"], frame["message"],
                             {"offset": frame["offset"], "topic": self.name},
@@ -490,6 +681,13 @@ class SocketEventBus:
     def topics(self) -> dict[str, SocketTopic]:
         with self._lock:
             return dict(self._topics)
+
+    def snapshot_status(self) -> dict:
+        return self._rpc.call({"op": "snapshot_status"})
+
+    def snapshot(self) -> dict:
+        """Force a broker snapshot + journal compaction now."""
+        return self._rpc.call({"op": "snapshot"})
 
     def close(self) -> None:
         with self._lock:

@@ -68,6 +68,7 @@ class CommandInterface:
             "profile": self.profile,
             "program_identity": self.program_identity,
             "stage_stats": self.stage_stats,
+            "faults": self.faults,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -166,6 +167,27 @@ class CommandInterface:
                 # log frames reflected in the serving tree) — the router's
                 # per-replica convergence signal (srv/router.py)
                 detail["policy_epoch"] = self.worker.policy_epoch()
+            watchdog = getattr(self.worker, "watchdog", None)
+            if watchdog is not None:
+                # device-health posture: quarantine state, timeout/restore
+                # counts, cumulative degraded seconds (srv/watchdog.py)
+                detail["device_watchdog"] = watchdog.status()
+            from .faults import REGISTRY as _faults
+
+            fault_stats = _faults.stats()
+            if fault_stats["enabled"] or fault_stats["hits_by_site"]:
+                # only present when faults are (or were) armed — a clean
+                # worker's health surface is unchanged
+                detail["failpoints"] = fault_stats
+            bus = getattr(self.worker, "bus", None)
+            if hasattr(bus, "snapshot_status"):
+                # broker durability posture: snapshot existence, offset
+                # watermark, journal tail length, snapshot age (broker-
+                # side RPC; unreachable broker must not fail the check)
+                try:
+                    detail["broker_snapshot"] = bus.snapshot_status()
+                except Exception:  # noqa: BLE001 — health stays serving
+                    pass
         except Exception as err:  # pragma: no cover
             healthy = False
             detail["error"] = str(err)
@@ -296,7 +318,38 @@ class CommandInterface:
         evaluator = self.service.evaluator
         if evaluator is not None and hasattr(evaluator, "table_fingerprint"):
             out["table_fingerprint"] = evaluator.table_fingerprint()
+        if evaluator is not None:
+            # device-health routing state: the chaos harness polls these
+            # to assert quarantine entry and kernel-path restore
+            out["kernel_active"] = evaluator.kernel_active
+            out["quarantined"] = bool(getattr(evaluator, "quarantined",
+                                              False))
         return out
+
+    def faults(self, payload: dict) -> dict:
+        """Runtime failpoint control (srv/faults.py): ``configure`` arms
+        a point list on a deterministic seed, ``clear`` disarms and
+        releases any hung threads, ``status`` (the default) reports armed
+        schedules and per-site hit counts."""
+        from .faults import REGISTRY
+
+        payload = payload or {}
+        action = payload.get("action", "status")
+        if action == "configure":
+            try:
+                REGISTRY.configure(
+                    list(payload.get("points") or []),
+                    seed=int(payload.get("seed", 0)),
+                )
+            except (KeyError, TypeError, ValueError) as err:
+                return {"error": f"bad fault spec: {err}"}
+            return {"status": "configured", **REGISTRY.stats()}
+        if action == "clear":
+            REGISTRY.clear()
+            return {"status": "cleared"}
+        if action == "status":
+            return REGISTRY.stats()
+        return {"error": f"unknown faults action {action!r}"}
 
     def stage_stats(self, payload: dict) -> dict:
         """Per-replica stage attribution for cluster benches: the stage

@@ -47,6 +47,27 @@ DEFAULT_CONFIG: dict = {
         # decision-cache invalidation.  Disable to force the pre-delta
         # full-recompile + global-flush behavior on every mutation.
         "delta_enabled": True,
+        # device-hang watchdog (srv/watchdog.py, docs/FAULTS.md).
+        # Disabled by default: materialize blocks exactly as before.
+        # Enabled: every pipeline/batcher materialize gets a hard
+        # deadline; timed-out batches resolve honestly down the
+        # kernel-retry -> oracle ladder (or 504 / 503 degraded — never a
+        # fabricated PERMIT/DENY), repeated timeouts trip the device
+        # circuit breaker which quarantines the kernel path (oracle-only
+        # serving) while a background probe re-initializes the kernel
+        # through the swap-stable jit registry and restores it.
+        "watchdog": {
+            "enabled": False,
+            "materialize_timeout_s": 5.0,
+            "probe_interval_s": 0.5,
+            "breaker": {
+                "window_s": 30.0,
+                "min_volume": 2,
+                "failure_ratio": 0.5,
+                "open_s": 1.0,
+                "half_open_probes": 1,
+            },
+        },
     },
     "seed_data": None,
     "server": {"transports": [{"provider": "grpc", "addr": "0.0.0.0:50061"}]},
@@ -164,6 +185,17 @@ DEFAULT_CONFIG: dict = {
             "coordinator": "127.0.0.1:8476",
             "num_processes": 1,
         },
+    },
+    # deterministic fault injection (srv/faults.py, docs/FAULTS.md).
+    # Disabled by default: every fire() site is one boolean test and the
+    # serving path is byte-identical (tests/test_admission.py
+    # differential).  Enabled: `points` arm named sites with
+    # error/delay/hang/torn actions on seeded deterministic schedules;
+    # the `faults` command (srv/command.py) re-arms/clears at runtime.
+    "faults": {
+        "enabled": False,
+        "seed": 0,
+        "points": [],
     },
     "logger": {"maskFields": ["password", "token"]},
 }

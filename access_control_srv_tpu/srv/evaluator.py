@@ -41,6 +41,7 @@ from ..ops.compile import DECISION_NAMES, compile_policies
 from ..ops.encode import encode_requests
 from ..ops.kernel import DecisionKernel
 from .decision_cache import request_features
+from .watchdog import DeviceTimeoutError
 
 
 class HybridEvaluator:
@@ -126,6 +127,13 @@ class HybridEvaluator:
         self._compile_state_lock = threading.Lock()
         self._compile_pending = False
         self._shutdown = False
+        # device-health state (srv/watchdog.py): a quarantined evaluator
+        # routes every decision path to the oracle until the watchdog's
+        # probe restores the kernel.  Plain bool store/load — readers see
+        # a flip at the next batch boundary, which is the granularity the
+        # quarantine needs.
+        self._watchdog = None
+        self._quarantined = False
         self.refresh(wait=True)  # oracle backend builds only the index
 
     # ------------------------------------------------------------- lifecycle
@@ -486,6 +494,97 @@ class HybridEvaluator:
     def kernel_active(self) -> bool:
         return self._kernel is not None
 
+    # --------------------------------------------- device-health plumbing
+
+    @property
+    def quarantined(self) -> bool:
+        return self._quarantined
+
+    def set_quarantined(self, flag: bool) -> None:
+        """Flipped by the device watchdog (srv/watchdog.py): True routes
+        every decision path to the oracle — degraded-but-correct serving
+        while the kernel path heals; False restores kernel routing."""
+        self._quarantined = bool(flag)
+
+    def attach_watchdog(self, watchdog) -> None:
+        self._watchdog = watchdog
+
+    @property
+    def watchdog(self):
+        return self._watchdog
+
+    def _guard_materialize(self, materialize):
+        """Bound a kernel materialize under the watchdog deadline when one
+        is attached; identity otherwise (the default path adds zero
+        indirection beyond this None check)."""
+        watchdog = self._watchdog
+        if watchdog is None:
+            return materialize
+        return lambda: watchdog.run(materialize)
+
+    def kernel_probe(self) -> bool:
+        """One canary batch through the live kernel's dispatch+materialize
+        — proves the device path answers end-to-end.  Used by the
+        watchdog's restore probe (bounded there); False when no kernel is
+        active.  Bypasses the watchdog wrap on purpose: the probe applies
+        its own deadline."""
+        with self._lock:
+            kernel = self._kernel
+            compiled = self._compiled
+        if kernel is None or compiled is None:
+            return False
+        from ..models.model import Request, Target
+
+        canary = Request(target=Target(), context={})
+        batch = encode_requests(
+            [canary], compiled, self.engine.resource_adapter
+        )
+        outputs = kernel.evaluate_async(batch)()
+        return len(outputs) == 3
+
+    def _hang_fallback(self, requests: list) -> list:
+        """Honest per-row resolution for a batch whose device materialize
+        timed out: rows with an already-expired deadline shed with the
+        deadline status, everything else takes the oracle walk (a real
+        evaluation — its cacheability stands), and a row the oracle
+        cannot answer gets the never-cacheable ``degraded`` envelope.
+        Never a fabricated PERMIT/DENY."""
+        from .admission import (
+            DEADLINE_CODE,
+            degraded_response,
+            overload_response,
+        )
+
+        expired = self._expired_rows(requests)
+        shed = overload_response(
+            DEADLINE_CODE, "deadline expired before evaluation"
+        )
+        out = []
+        n_oracle = 0
+        n_degraded = 0
+        for b, request in enumerate(requests):
+            if b in expired:
+                out.append(shed)
+                continue
+            try:
+                out.append(self._oracle_is_allowed(request))
+                n_oracle += 1
+            except Exception:  # noqa: BLE001 — honest envelope below
+                out.append(degraded_response(
+                    "device materialize timed out and the oracle "
+                    "fallback failed"
+                ))
+                n_degraded += 1
+        self._count_path("hang-fallback-oracle", n_oracle)
+        self._count_path("hang-fallback-degraded", n_degraded)
+        self._count_path("deadline-expired", len(expired))
+        self._slog.warning(
+            "hang-fallback",
+            "device materialize timeout: %d rows to oracle, %d shed, "
+            "%d degraded", n_oracle, len(expired), n_degraded,
+        )
+        return out
+
     @property
     def native_active(self) -> bool:
         return self._native_encoder is not None
@@ -515,7 +614,8 @@ class HybridEvaluator:
         with self._lock:
             kernel = self._kernel
             encoder = self._native_encoder
-        if kernel is None or encoder is None or self.backend == "oracle":
+        if (kernel is None or encoder is None or self.backend == "oracle"
+                or self._quarantined):
             return None
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
@@ -526,7 +626,7 @@ class HybridEvaluator:
             now = time.perf_counter()
             tracer.record(span, STAGE_WIRE_ENCODE, now - t_stage)
         t_device = time.perf_counter()
-        materialize = kernel.evaluate_async(batch)
+        materialize = self._guard_materialize(kernel.evaluate_async(batch))
 
         def finalize():
             decision, cacheable, status = materialize()
@@ -549,7 +649,9 @@ class HybridEvaluator:
                 retry = encoder.encode_wire(
                     [messages[b] for b in idx], caps=dict(_CAPS_CEIL)
                 )
-                d2, c2, s2 = kernel.evaluate(retry)
+                d2, c2, s2 = self._guard_materialize(
+                    kernel.evaluate_async(retry)
+                )()
                 # kernel outputs are read-only views on device buffers
                 decision = np.array(decision)
                 cacheable = np.array(cacheable)
@@ -818,6 +920,7 @@ class HybridEvaluator:
             self.backend == "oracle"
             or compiled is None
             or kernel is None
+            or self._quarantined
             or compiled.n_rules < REVERSE_MIN_RULES
         ):
             self._count_path("oracle-wia", len(requests))
@@ -985,7 +1088,7 @@ class HybridEvaluator:
         with self._lock:
             kernel = self._kernel
             compiled = self._compiled
-        if self.backend == "oracle" or kernel is None:
+        if self.backend == "oracle" or kernel is None or self._quarantined:
             # candidate-filtered like every other oracle path (skipped
             # rules provably cannot target-match; bit-identical) — the
             # unfiltered walk costs O(total rules) per row, ~21 ms on a
@@ -1047,11 +1150,15 @@ class HybridEvaluator:
             now = time.perf_counter()
             tracer.fan_out(requests, STAGE_ENCODE, now - t_stage)
         t_device = time.perf_counter()
-        materialize = kernel.evaluate_async(batch)
+        materialize = self._guard_materialize(kernel.evaluate_async(batch))
 
         def finalize():
+            try:
+                outputs = materialize()
+            except DeviceTimeoutError:
+                return self._hang_fallback(requests)
             return self._decode_batch(
-                requests, batch, materialize(), tracer, t_device
+                requests, batch, outputs, tracer, t_device
             )
 
         return finalize

@@ -228,6 +228,11 @@ class CachingIdentityClient:
         if self.breaker is not None and not self.breaker.allow():
             return _breaker_envelope()
         try:
+            # failpoint (srv/faults.py): an injected outage takes the
+            # real failure path — breaker failure, row fails closed
+            from .faults import REGISTRY as FAULTS
+
+            FAULTS.fire("identity.resolve")
             out = self.inner.find_by_token(token)
         except Exception:
             if self.breaker is not None:
@@ -331,6 +336,11 @@ class GrpcIdentityClient:
         if self.breaker is not None and not self.breaker.allow():
             return _breaker_envelope()
         try:
+            # failpoint (srv/faults.py): injected identity-srv outage,
+            # resolved to the honest 5xx envelope below (never cached)
+            from .faults import REGISTRY as FAULTS
+
+            FAULTS.fire("identity.grpc")
             resp = self._call(
                 self._pb.FindByTokenRequest(token=token),
                 timeout=self.timeout,

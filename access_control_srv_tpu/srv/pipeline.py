@@ -116,10 +116,22 @@ class DevicePipeline:
 
         worker = self.worker
         try:
-            if finalize is None:
+            result = None
+            if finalize is not None:
+                from .watchdog import DeviceTimeoutError
+
+                try:
+                    result = finalize()
+                except DeviceTimeoutError:
+                    # wedged device fetch: the staging lease stays out (the
+                    # aliasing rule forbids recycling buffers the device
+                    # may still read) and the frame resolves honestly
+                    # through the pb path — the quarantined evaluator
+                    # routes it to the oracle, never a fabricated decision
+                    result = None
+            if result is None:
                 payload = self._pb_fallback(raw, deadline, span)
             else:
-                result = finalize()
                 batch = result[0]
                 tracer = None
                 obs = getattr(worker, "obs", None)

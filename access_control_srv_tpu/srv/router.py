@@ -44,6 +44,7 @@ from typing import Optional
 import grpc
 
 from .admission import CircuitBreaker
+from .faults import REGISTRY as FAULTS
 from .gen import access_control_pb2 as pb
 from .telemetry import Histogram
 from .transport_grpc import (
@@ -61,6 +62,18 @@ _COMMAND_METHODS = (
 _STREAM_SUFFIX = "/IsAllowedStream"
 
 _identity = lambda raw: raw  # noqa: E731 — raw-bytes pass-through
+
+
+class _InjectedUnavailable(grpc.RpcError):
+    """Failpoint stand-in for a replica transport failure: quacks like a
+    grpc.RpcError so the router's retry/exclusion path treats it exactly
+    like a real wire error."""
+
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self):
+        return "fault injected at router.proxy"
 
 
 def _deadline_budget(context) -> Optional[float]:
@@ -307,6 +320,9 @@ class ClusterRouter:
             )
             t_call = time.perf_counter()
             try:
+                # failpoint (srv/faults.py): replica hop — error takes the
+                # real retry/exclusion path below, like a wire failure
+                FAULTS.fire("router.proxy", exc=_InjectedUnavailable)
                 payload, call = fn.with_call(
                     raw, metadata=metadata, timeout=remaining
                 )

@@ -519,6 +519,13 @@ class Telemetry:
         self.admission = Counter()
         self.admission_queue_depth = ValueHistogram()
         self.admission_budget = Histogram()
+        # deterministic fault injection (srv/faults.py): per-site hit
+        # counts, fed by the registry's on_hit hook — operators see
+        # exactly which failpoints fired and how often
+        self.failpoints = Counter()
+        # device-hang watchdog (srv/watchdog.py): attached by the worker
+        # when enabled; the degraded/quarantine gauges read 0 without one
+        self._watchdog = None
         # per-stage pipeline durations (srv/tracing.StageTracer writes
         # here): stage name -> Histogram.  Empty unless tracing is
         # enabled, so the snapshot/exposition surface only grows when the
@@ -569,11 +576,35 @@ class Telemetry:
         reg.histogram("acs_admission_budget_seconds",
                       "Remaining deadline budget at admit",
                       self.admission_budget)
+        reg.counter("acs_failpoint_hits_total",
+                    "Deterministic fault-injection hits per site "
+                    "(srv/faults.py)", self.failpoints, label="site")
+        reg.gauge("acs_degraded_seconds",
+                  "Cumulative seconds the device kernel path has been "
+                  "quarantined (srv/watchdog.py)", self._degraded_seconds)
+        reg.gauge("acs_device_quarantined",
+                  "1 while the device kernel path is quarantined",
+                  self._quarantined_gauge)
         reg.histogram_group(
             "acs_stage_duration_seconds",
             "Per-stage pipeline duration (srv/tracing.py taxonomy)",
             self._stages_view, label="stage",
         )
+
+    def set_watchdog(self, watchdog) -> None:
+        """Attach the device watchdog so the degraded/quarantine gauges
+        and the snapshot's device_watchdog block read live state."""
+        self._watchdog = watchdog
+
+    def _degraded_seconds(self) -> float:
+        watchdog = self._watchdog
+        if watchdog is None:
+            return 0.0
+        return round(watchdog.degraded_seconds(), 3)
+
+    def _quarantined_gauge(self) -> int:
+        watchdog = self._watchdog
+        return int(watchdog is not None and watchdog.quarantined)
 
     def _stages_view(self) -> dict:
         """Consistent copy of the stage-histogram map for render():
@@ -612,6 +643,14 @@ class Telemetry:
         self.paths.inc(path, rows)
 
     def snapshot(self) -> dict:
+        # watchdog/failpoint state reads its own locks BEFORE the snapshot
+        # lock — no nested lock order between telemetry and the watchdog
+        watchdog = self._watchdog
+        wd_status = None if watchdog is None else watchdog.status()
+        from .faults import REGISTRY as _faults_registry
+
+        failpoint_hits = self.failpoints.snapshot()
+        faults_enabled = _faults_registry.enabled
         # assembled under the snapshot lock and returned as a DEEP copy:
         # concurrent `metrics`/`health_check` readers serialize their own
         # private tree — they can never observe a dict mutating under a
@@ -645,6 +684,16 @@ class Telemetry:
                     stage: hist.snapshot()
                     for stage, hist in sorted(self.stages.items())
                 }
+            # fault-injection / device-health blocks only appear when the
+            # subsystems are live — snapshots of an untouched worker stay
+            # byte-identical to the pre-failpoint shape
+            if faults_enabled or failpoint_hits:
+                out["failpoints"] = {
+                    "enabled": faults_enabled,
+                    "hits": failpoint_hits,
+                }
+            if wd_status is not None:
+                out["device_watchdog"] = wd_status
             return copy.deepcopy(out)
 
 

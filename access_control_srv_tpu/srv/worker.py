@@ -63,6 +63,8 @@ class Worker:
         self.mesh = None
         self.obs = None  # srv/tracing.Observability (None = disabled)
         self.replicator = None
+        self.watchdog = None  # srv/watchdog.DeviceWatchdog (None = off)
+        self._faults_armed = False
         # live CRUD-offset watermark per topic (policy_epoch fallback for
         # workers without a replicator)
         self._epoch_lock = threading.Lock()
@@ -306,6 +308,39 @@ class Worker:
             observability=self.obs,
         )
 
+        # deterministic fault injection (srv/faults.py): arm the process
+        # registry from config — OFF by default, and configure_from leaves
+        # the registry disarmed when the block is absent/disabled, so the
+        # serving path stays byte-identical (tests/test_admission.py
+        # differential)
+        from .faults import REGISTRY as _faults_registry
+        from .faults import configure_from as _faults_configure
+
+        self._faults_armed = _faults_configure(cfg.get("faults"))
+        if self.telemetry is not None:
+            _faults_registry.on_hit = self.telemetry.failpoints.inc
+
+        # device-hang watchdog (srv/watchdog.py): bounded materialize +
+        # kernel-path quarantine + probe-driven restore.  OFF by default;
+        # enabled it attaches to the evaluator so every kernel
+        # materialize runs under the deadline.
+        wd_cfg = cfg.get("evaluator:watchdog") or {}
+        if wd_cfg.get("enabled"):
+            from .watchdog import DeviceWatchdog
+
+            self.watchdog = DeviceWatchdog(
+                self.evaluator,
+                materialize_timeout_s=float(
+                    wd_cfg.get("materialize_timeout_s", 5.0)
+                ),
+                probe_interval_s=float(wd_cfg.get("probe_interval_s", 0.5)),
+                breaker_cfg=wd_cfg.get("breaker"),
+                telemetry=self.telemetry,
+                logger=self.logger,
+            )
+            if self.telemetry is not None:
+                self.telemetry.set_watchdog(self.watchdog)
+
         # policy store with self-authorization hook; the hook consults the
         # live config so authorization:enabled can be toggled at runtime via
         # config_update (reference: tests drive cfg.set + updateConfig,
@@ -407,6 +442,15 @@ class Worker:
         return self
 
     def stop(self) -> None:
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.close()
+        if getattr(self, "_faults_armed", False):
+            # release any hung threads and disarm — only when THIS worker
+            # armed the registry (in-process tests arm via REGISTRY.arm)
+            from .faults import REGISTRY as _faults_registry
+
+            _faults_registry.clear()
+            self._faults_armed = False
         if getattr(self, "wire_pipeline", None) is not None:
             self.wire_pipeline.stop()
         if self.batcher is not None:

@@ -71,7 +71,8 @@ _CONFIG_KNOBS = (
     "OVERLOAD_GENERATORS", "OVERLOAD_WARMUP_S", "OVERLOAD_CAL_THREADS",
     "OVERLOAD_RULES", "PROFILE_RULES", "PROFILE_BATCH", "PROFILE_CALLS",
     "CLUSTER_BATCH", "CLUSTER_CALLS", "CLUSTER_CLIENTS",
-    "CLUSTER_UNARY_PROBES",
+    "CLUSTER_UNARY_PROBES", "DEGRADED_RULES", "DEGRADED_BATCH",
+    "DEGRADED_DURATION_S",
 )
 
 
@@ -1895,6 +1896,106 @@ def bench_overload():
         worker.stop()
 
 
+def bench_degraded_mode():
+    """Device-hang degraded serving (srv/watchdog.py): decisions/s and
+    per-batch p99 on both sides of a watchdog quarantine — healthy
+    (kernel path) vs quarantined (oracle-only) — plus the probe-driven
+    recovery time back to the kernel path after the hang clears.  The
+    hang is a deterministic ``device.materialize`` failpoint
+    (srv/faults.py) armed in-process; the bar is HONEST degradation:
+    every row during the hang resolves 200 (oracle fallback) or an
+    explicit 5xx envelope, never a fabricated PERMIT/DENY, and recovery
+    is bounded by a few probe intervals."""
+    from access_control_srv_tpu.srv.faults import REGISTRY
+
+    n_rules = int(os.environ.get("DEGRADED_RULES", 2048))
+    batch_rows = int(os.environ.get("DEGRADED_BATCH", 64))
+    duration_s = float(os.environ.get("DEGRADED_DURATION_S", 2.0))
+    probe_interval_s = 0.05
+    worker, server, client = _serving_worker(n_rules, cfg_extra={
+        # the cache would absorb the repeat batch and measure nothing
+        "decision_cache": {"enabled": False},
+        "evaluator": {"watchdog": {
+            "enabled": True,
+            "materialize_timeout_s": 0.2,
+            "probe_interval_s": probe_interval_s,
+            "breaker": {"window_s": 10.0, "min_volume": 2,
+                        "failure_ratio": 0.3, "open_s": 0.2,
+                        "half_open_probes": 1},
+        }},
+    })
+    rng = np.random.default_rng(11)
+    msg = _serving_batch_msg(batch_rows, rng)
+
+    def timed_phase():
+        for _ in range(3):  # absorb per-shape XLA compiles / cold oracle
+            client.is_allowed_batch(msg)
+        lat = []
+        rows_200 = 0
+        t0 = time.perf_counter()
+        t_end = t0 + duration_s
+        while time.perf_counter() < t_end:
+            t = time.perf_counter()
+            out = client.is_allowed_batch(msg)
+            lat.append(time.perf_counter() - t)
+            for resp in out.responses:
+                code = resp.operation_status.code
+                assert code == 200 or code >= 500, code
+                rows_200 += code == 200
+        wall = time.perf_counter() - t0
+        lat.sort()
+        p99_ms = lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3
+        return rows_200 / wall, p99_ms
+
+    watchdog = worker.watchdog
+    try:
+        healthy_rps, healthy_p99 = timed_phase()
+        # wedge the device: every materialize hangs, the watchdog bounds
+        # each at materialize_timeout_s and the breaker trips quarantine
+        REGISTRY.configure([{"site": "device.materialize",
+                             "action": "hang", "hang_s": 60.0}], seed=11)
+        deadline = time.monotonic() + 30.0
+        while not watchdog.quarantined and time.monotonic() < deadline:
+            client.is_allowed_batch(msg)
+        if not watchdog.quarantined:
+            raise RuntimeError("device hang never tripped quarantine")
+        degraded_rps, degraded_p99 = timed_phase()
+        # recovery: release the hang and time the probe-driven restore
+        t_clear = time.perf_counter()
+        REGISTRY.clear()
+        deadline = time.monotonic() + 30.0
+        while watchdog.quarantined and time.monotonic() < deadline:
+            time.sleep(probe_interval_s / 5)
+        recovery_s = time.perf_counter() - t_clear
+        status = watchdog.status()
+        if status["quarantined"]:
+            raise RuntimeError(f"kernel path never restored: {status}")
+        return _result(
+            f"isAllowed quarantined decisions/sec (degraded-mode, "
+            f"{n_rules}-rule tree, batch {batch_rows})",
+            degraded_rps,
+            "decisions/s",
+            extra={
+                "healthy_dec_s": round(healthy_rps, 1),
+                "healthy_p99_ms": round(healthy_p99, 3),
+                "degraded_p99_ms": round(degraded_p99, 3),
+                "recovery_to_kernel_s": round(recovery_s, 3),
+                "device_timeouts": status["timeouts"],
+                "quarantines": status["quarantines"],
+                "restores": status["restores"],
+                "degraded_seconds": status["degraded_seconds"],
+                "bar": "quarantined rows resolve honestly (oracle 200 or "
+                       "5xx envelope, never fabricated); recovery to the "
+                       "kernel path bounded by probe cadence",
+            },
+        )
+    finally:
+        REGISTRY.clear()
+        client.close()
+        server.stop()
+        worker.stop()
+
+
 def bench_cluster_scale():
     """Pod-scale replica serving (PR 9): closed-loop decisions/s through
     the ClusterRouter at 1 vs 2 worker replica processes, per-replica
@@ -2102,7 +2203,8 @@ def main():
                              "serve-latency", "wire-profile",
                              "wire-pipeline", "token-mix",
                              "adapter-mixed", "adapter-mixed-warm",
-                             "crud-churn", "overload", "cluster-scale"]
+                             "crud-churn", "overload", "degraded-mode",
+                             "cluster-scale"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -2186,6 +2288,7 @@ def main():
         "adapter-mixed-warm": bench_adapter_mixed_warm,
         "crud-churn": bench_crud_churn,
         "overload": bench_overload,
+        "degraded-mode": bench_degraded_mode,
         "cluster-scale": bench_cluster_scale,
     }
     for name in which:

@@ -37,24 +37,37 @@ def pytest_configure(config):
         "replicas behind a router); enforced hard per-test timeout — "
         "override with @pytest.mark.cluster(timeout=N)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection matrix tests (failpoints armed inside "
+        "subprocess replicas, crash/corruption recovery); enforced hard "
+        "per-test timeout — override with @pytest.mark.chaos(timeout=N)",
+    )
 
 
 # hard ceiling for one cluster-marked test: a hung replica handshake or
 # a stuck convergence poll must fail the test, not the whole tier-1 run
 CLUSTER_TEST_TIMEOUT_S = 180
+# chaos tests deliberately wedge processes (hangs, torn journals) before
+# recovering, so they get more headroom than plain cluster bring-up
+CHAOS_TEST_TIMEOUT_S = 300
 
 
 @pytest.fixture(autouse=True)
 def _cluster_hard_timeout(request):
-    """SIGALRM watchdog for @pytest.mark.cluster tests (no pytest-timeout
-    in the image).  Tests run on the main thread, so the alarm handler's
-    TimeoutError surfaces as an ordinary test failure with a traceback
-    pointing at the stuck line."""
+    """SIGALRM watchdog for @pytest.mark.cluster / @pytest.mark.chaos
+    tests (no pytest-timeout in the image).  Tests run on the main
+    thread, so the alarm handler's TimeoutError surfaces as an ordinary
+    test failure with a traceback pointing at the stuck line."""
     marker = request.node.get_closest_marker("cluster")
+    default_s = CLUSTER_TEST_TIMEOUT_S
+    if marker is None:
+        marker = request.node.get_closest_marker("chaos")
+        default_s = CHAOS_TEST_TIMEOUT_S
     if marker is None or not hasattr(signal, "SIGALRM"):
         yield
         return
-    timeout_s = int(marker.kwargs.get("timeout", CLUSTER_TEST_TIMEOUT_S))
+    timeout_s = int(marker.kwargs.get("timeout", default_s))
 
     def on_alarm(signum, frame):
         raise TimeoutError(

@@ -602,7 +602,7 @@ class TestWorkerDifferential:
     no-admission run — admission decides WHETHER a request is evaluated,
     never WHAT the decision is."""
 
-    def _responses(self, admission_enabled):
+    def _responses(self, admission_enabled, faults_block=None):
         from access_control_srv_tpu.srv import Worker
         from access_control_srv_tpu.srv.transport_grpc import (
             response_to_pb,
@@ -611,6 +611,8 @@ class TestWorkerDifferential:
 
         cfg = seed_cfg()
         cfg["admission"] = {"enabled": admission_enabled}
+        if faults_block is not None:
+            cfg["faults"] = faults_block
         worker = Worker().start(cfg)
         try:
             requests = [admin_request(), admin_request(role="nobody"),
@@ -638,6 +640,113 @@ class TestWorkerDifferential:
         with_admission = self._responses(True)
         without = self._responses(False)
         assert with_admission == without
+
+    def test_disabled_failpoints_leave_serving_byte_identical(self):
+        """A faults block that is present but disabled must not perturb
+        a single response byte — the failpoint framework is OFF by
+        default and configure_from leaves the registry disarmed."""
+        from access_control_srv_tpu.srv.faults import REGISTRY
+
+        armed = self._responses(True, faults_block={
+            "enabled": False,
+            "seed": 7,
+            "points": [
+                {"site": "device.dispatch", "action": "error"},
+                {"site": "broker.journal.write", "action": "torn"},
+            ],
+        })
+        assert REGISTRY.enabled is False
+        assert armed == self._responses(True)
+
+
+# --------------------------------------------------- degraded envelope
+
+
+class TestDegradedStatus:
+    """The device-health envelope (admission.degraded_response) is a
+    distinct honest 503: INDETERMINATE decision, never cacheable, never
+    a fabricated PERMIT/DENY — and separable from the load-shed and
+    drain envelopes that share the 5xx band."""
+
+    def test_envelope_shape_and_distinct_from_shed(self):
+        from access_control_srv_tpu.srv.admission import (
+            DEGRADED_CODE,
+            degraded_response,
+            overload_response,
+        )
+
+        resp = degraded_response("device materialize timed out")
+        assert resp.operation_status.code == DEGRADED_CODE == 503
+        assert resp.decision == Decision.INDETERMINATE
+        assert resp.evaluation_cacheable is False
+        assert resp.operation_status.message.startswith("degraded")
+        # shed and drain envelopes never carry the degraded marker: an
+        # operator (or the router's retry policy) can tell device-health
+        # 503s from load 503s by message
+        for code, msg in ((OVERLOAD_CODE, "queue full"),
+                          (SHUTDOWN_CODE, "draining")):
+            shed = overload_response(code, msg)
+            assert "degraded" not in shed.operation_status.message
+
+    def test_degraded_rows_are_never_cached(self):
+        from access_control_srv_tpu.srv.admission import degraded_response
+        from access_control_srv_tpu.srv.decision_cache import DecisionCache
+
+        cache = DecisionCache(ttl_s=60.0, max_entries=16)
+        stored = cache.put("k-degraded", degraded_response("quarantined"),
+                           epoch=cache.epoch)
+        assert stored is False
+        assert cache.get("k-degraded") is None
+
+    def test_hang_fallback_ladder_is_honest(self):
+        """Per-row resolution after a device timeout: expired rows shed
+        504, oracle-answerable rows get a REAL evaluation, rows the
+        oracle cannot answer get the degraded envelope — no row is ever
+        a fabricated PERMIT/DENY."""
+        from access_control_srv_tpu.srv.admission import DEGRADED_CODE
+        from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+
+        permit = Response(
+            decision=Decision.PERMIT, obligations=[],
+            evaluation_cacheable=True,
+            operation_status=OperationStatus(code=200, message=""),
+        )
+        counted = {}
+
+        class Shim:
+            _hang_fallback = HybridEvaluator._hang_fallback
+
+            def _expired_rows(self, requests):
+                return {0}
+
+            def _oracle_is_allowed(self, request):
+                if getattr(request, "broken", False):
+                    raise RuntimeError("oracle cannot resolve")
+                return permit
+
+            def _count_path(self, path, rows):
+                counted[path] = counted.get(path, 0) + rows
+
+            class _slog:
+                @staticmethod
+                def warning(*args, **kwargs):
+                    pass
+
+        class Row:
+            def __init__(self, broken=False):
+                self.broken = broken
+
+        rows = [Row(), Row(), Row(broken=True)]
+        out = Shim()._hang_fallback(rows)
+        assert out[0].operation_status.code == DEADLINE_CODE
+        assert out[1] is permit
+        assert out[2].operation_status.code == DEGRADED_CODE
+        assert out[2].decision == Decision.INDETERMINATE
+        assert out[2].evaluation_cacheable is False
+        assert "degraded" in out[2].operation_status.message
+        assert counted == {"hang-fallback-oracle": 1,
+                           "hang-fallback-degraded": 1,
+                           "deadline-expired": 1}
 
 
 class TestBrokerFsyncInterval:

@@ -621,3 +621,174 @@ def test_subscription_survives_broker_restart(tmp_path):
         bus.close()
         proc.kill()
         proc.wait(timeout=10)
+
+
+# --------------------------------------------- snapshot + compaction (PR 11)
+
+
+def test_snapshot_compacts_journal_and_survives_restart(tmp_path):
+    """snapshot_every=N: the N-th journal record triggers a
+    crash-consistent snapshot and the journal restarts empty behind it;
+    a cold restart replays snapshot + tail and reproduces the exact
+    topic/KV/offset state of a full-journal replay."""
+    data_dir = str(tmp_path / "snap-data")
+    server = BrokerServer(data_dir=data_dir, snapshot_every=5).start()
+    try:
+        bus = SocketEventBus(server.address)
+        topic = bus.topic("snap.topic")
+        for i in range(4):
+            topic.emit("thing", {"i": i})
+        status = bus.snapshot_status()
+        assert status["exists"] is False  # 4 records < snapshot_every
+        assert status["tail_records"] == 4
+        # the 5th record crosses the cadence: snapshot + truncation
+        topic.emit("thing", {"i": 4})
+        status = bus.snapshot_status()
+        assert status["exists"] is True
+        assert status["watermark"] == 5
+        assert status["tail_records"] == 0
+        assert status["age_s"] is not None and status["age_s"] >= 0
+        # tail after the snapshot
+        offsets = SocketOffsetStore(server.address)
+        offsets.commit("snap.topic", 2)
+        cache = SocketSubjectCache(server.address)
+        cache.set("cache:snap:subject", {"id": "snap"})
+        bus.close(); offsets.close(); cache.close()
+    finally:
+        server.stop()
+
+    # journal holds ONLY the post-snapshot tail
+    with open(os.path.join(data_dir, "broker.journal")) as fh:
+        tail_lines = [ln for ln in fh if ln.strip()]
+    assert len(tail_lines) == 2
+
+    server2 = BrokerServer(data_dir=data_dir, snapshot_every=5).start()
+    try:
+        bus = SocketEventBus(server2.address)
+        topic = bus.topic("snap.topic")
+        assert [m["i"] for _, m in topic.read(0)] == [0, 1, 2, 3, 4]
+        offsets = SocketOffsetStore(server2.address)
+        assert offsets.get("snap.topic") == 2
+        cache = SocketSubjectCache(server2.address)
+        assert cache.get("cache:snap:subject") == {"id": "snap"}
+        status = bus.snapshot_status()
+        assert status["watermark"] == 5
+        assert status["tail_records"] == 2
+        bus.close(); offsets.close(); cache.close()
+    finally:
+        server2.stop()
+
+
+def test_forced_snapshot_command_roundtrip(tmp_path):
+    """The ``snapshot`` wire op compacts on demand (no cadence set)."""
+    data_dir = str(tmp_path / "force-data")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        bus.topic("t").emit("a", {"n": 1})
+        bus.topic("t").emit("b", {"n": 2})
+        status = bus.snapshot()
+        assert status["exists"] is True and status["tail_records"] == 0
+        assert os.path.getsize(
+            os.path.join(data_dir, "broker.journal")) == 0
+        bus.close()
+    finally:
+        server.stop()
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server2.address)
+        assert bus.topic("t").read(0) == [("a", {"n": 1}),
+                                          ("b", {"n": 2})]
+        bus.close()
+    finally:
+        server2.stop()
+
+
+def test_corrupt_snapshot_fails_closed(tmp_path):
+    """A flipped byte in the snapshot state fails the CRC: boot ignores
+    the snapshot (reporting the error) instead of loading torn state."""
+    data_dir = str(tmp_path / "corrupt-snap")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        bus.topic("t").emit("a", {"n": 1})
+        bus.snapshot()
+        bus.close()
+    finally:
+        server.stop()
+    path = os.path.join(data_dir, "broker.snapshot")
+    blob = json.load(open(path))
+    assert '"n":1' in blob["state"]
+    blob["state"] = blob["state"].replace('"n":1', '"n":9')
+    json.dump(blob, open(path, "w"))
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        assert "snapshot_error" in (server2.recovered or {})
+        bus = SocketEventBus(server2.address)
+        # compaction emptied the journal, so fail-closed means empty
+        # state — never the silently-corrupted payload
+        assert bus.topic("t").read(0) == []
+        bus.close()
+    finally:
+        server2.stop()
+
+
+def test_journal_crc_detects_midfile_corruption(tmp_path):
+    """A flipped byte mid-journal fails that record's CRC: replay keeps
+    the consistent prefix, truncates there, and reports what it
+    dropped."""
+    data_dir = str(tmp_path / "crc-data")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        topic = bus.topic("t")
+        for i in range(5):
+            topic.emit("thing", {"i": i})
+        bus.close()
+    finally:
+        server.stop()
+    path = os.path.join(data_dir, "broker.journal")
+    lines = open(path).readlines()
+    assert len(lines) == 5 and all(ln.startswith("C") for ln in lines)
+    lines[2] = lines[2].replace('"i": 2', '"i": 7')  # flip bytes, keep CRC
+    open(path, "w").writelines(lines)
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        assert server2.recovered and server2.recovered["dropped_bytes"] > 0
+        bus = SocketEventBus(server2.address)
+        assert [m["i"] for _, m in bus.topic("t").read(0)] == [0, 1]
+        bus.close()
+    finally:
+        server2.stop()
+
+
+def test_torn_write_failpoint_recovers_prefix(tmp_path):
+    """Arm the ``broker.journal.write`` torn failpoint inside an
+    in-process broker: the torn append is detected on replay (CRC +
+    missing newline) and the journal truncates back to the consistent
+    prefix."""
+    from access_control_srv_tpu.srv.faults import REGISTRY
+
+    data_dir = str(tmp_path / "torn-data")
+    server = BrokerServer(data_dir=data_dir).start()
+    try:
+        bus = SocketEventBus(server.address)
+        topic = bus.topic("t")
+        for i in range(3):
+            topic.emit("thing", {"i": i})
+        with REGISTRY.arm([{"site": "broker.journal.write",
+                            "action": "torn", "torn_frac": 0.4}]):
+            topic.emit("thing", {"i": 3})  # torn on disk, live in memory
+        assert [m["i"] for _, m in topic.read(0)] == [0, 1, 2, 3]
+        bus.close()
+    finally:
+        server.stop()
+    server2 = BrokerServer(data_dir=data_dir).start()
+    try:
+        assert server2.recovered and server2.recovered["dropped_bytes"] > 0
+        bus = SocketEventBus(server2.address)
+        # the torn record is gone; the prefix survives intact
+        assert [m["i"] for _, m in bus.topic("t").read(0)] == [0, 1, 2]
+        bus.close()
+    finally:
+        server2.stop()

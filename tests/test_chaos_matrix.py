@@ -1,0 +1,607 @@
+"""Chaos matrix (PR 11 tentpole): deterministic fault classes driven
+through the 2-replica cluster tier, re-running the PR 9 journal-exact
+stale-decision oracle after every soak.
+
+Fault classes covered here:
+
+1. replica kill      — SIGKILL mid-churn + journal-replay convergence
+2. identity outage   — ``identity.grpc`` failpoint inside live replicas;
+                       token rows fail closed, never PERMIT, and recover
+3. device hang       — ``device.materialize`` hang inside a replica; the
+                       watchdog bounds it, trips quarantine, and the
+                       probe restores the kernel path (verified via
+                       ``program_identity``)
+4. journal torn-tail — crash-interrupted broker append; reboot recovers
+                       the consistent prefix, zero real frames lost
+5. mid-file corruption — flipped byte in a CRC'd journal record; reboot
+                       truncates to the consistent prefix and replicas
+                       converge on the journal-exact state
+6. adapter flap      — ``adapter.http`` failpoint under a live GraphQL
+                       endpoint; per-row transport errors only, no
+                       fabricated payloads, full recovery on clear
+
+Classes 1-3 share one cluster soak (records feed the journal-exact
+oracle); 4-5 share one broker-tamper reboot sequence that also proves
+the snapshot+tail cold boot converges to the same ``table_fingerprint``
+as the full-journal state it snapshotted."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import grpc
+import pytest
+
+from access_control_srv_tpu.parallel.cluster import LocalCluster
+from access_control_srv_tpu.srv.broker import SocketEventBus
+from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+from access_control_srv_tpu.srv.router import POLICY_EPOCH_METADATA_KEY
+
+from .cluster_util import (
+    command_over,
+    create_reader_policy_tree,
+    reader_rule_doc,
+    seed_paths,
+    upsert_rule,
+    wait_converged,
+    wire_request,
+)
+from .utils import URNS
+
+SHED_CODES = (429, 503, 504)
+RULE_ID = "r_matrix"
+ORG = "urn:restorecommerce:acs:model:organization.Organization"
+RULES_TOPIC = "io.restorecommerce.rules.resource"
+
+WATCHDOG_CFG = {
+    "enabled": True,
+    "materialize_timeout_s": 0.5,
+    "probe_interval_s": 0.3,
+    "breaker": {"window_s": 8.0, "min_volume": 2, "failure_ratio": 0.3,
+                "open_s": 0.5, "half_open_probes": 1},
+}
+
+
+def _replica_command(addr: str, name: str, payload=None) -> dict:
+    channel = grpc.insecure_channel(addr)
+    try:
+        return command_over(channel, name, payload)
+    finally:
+        channel.close()
+
+
+def _arm(addr: str, points: list, seed: int = 11) -> dict:
+    out = _replica_command(addr, "faults", {
+        "action": "configure", "points": points, "seed": seed,
+    })
+    assert out.get("status") == "configured", out
+    return out
+
+
+def _clear(addr: str) -> None:
+    out = _replica_command(addr, "faults", {"action": "clear"})
+    assert out.get("status") == "cleared", out
+
+
+def _token_request(token: str) -> pb.Request:
+    msg = pb.Request()
+    msg.target.subjects.add(id=URNS["role"], value="superadministrator-r-id")
+    msg.target.resources.add(id=URNS["entity"], value=ORG)
+    msg.target.resources.add(id=URNS["resourceID"], value="O1")
+    msg.target.actions.add(id=URNS["actionID"], value=URNS["read"])
+    msg.context.subject.value = json.dumps({"token": token}).encode()
+    return msg
+
+
+def _run_oracle(records, flip_acks, broker_addr):
+    """The PR 9 journal-exact stale-decision oracle (see
+    tests/test_cluster_chaos.py for the derivation)."""
+    bus = SocketEventBus(broker_addr)
+    try:
+        rule_frames = bus.topic(RULES_TOPIC).read(0)
+        other = sum(
+            len(bus.topic(
+                f"io.restorecommerce.{kind}s.resource"
+            ).read(0))
+            for kind in ("policy", "policy_set")
+        )
+    finally:
+        bus.close()
+    effect_at: list = []
+    current = None
+    for _event, message in rule_frames:
+        doc = (message or {}).get("payload") or {}
+        if doc.get("id") == RULE_ID:
+            current = doc.get("effect")
+        effect_at.append(current)
+    expected = {"PERMIT": pb.PERMIT, "DENY": pb.DENY, None: None}
+
+    def ok_at(epoch: int, decision) -> bool:
+        k = epoch - other
+        if k < 1 or k > len(effect_at):
+            return False
+        want = expected[effect_at[k - 1]]
+        return want is not None and decision == want
+
+    stale = []
+    for t_send, t_recv, code, decision, epoch in records:
+        if code != 200:
+            continue
+        assert epoch >= 0, "decision response missing epoch stamp"
+        if ok_at(epoch, decision):
+            continue
+        in_flight = any(
+            t_before <= t_recv + 0.25 and t_ack >= t_send - 1.0
+            for t_before, t_ack in flip_acks
+        )
+        if in_flight and (
+            ok_at(epoch - 1, decision) or ok_at(epoch + 1, decision)
+        ):
+            continue
+        stale.append((t_send, code, decision, epoch))
+    assert not stale, (
+        f"{len(stale)} stale decisions, e.g. {stale[:5]}; "
+        f"{len(rule_frames)} rule frames, other={other}"
+    )
+
+
+@pytest.mark.chaos(timeout=280)
+def test_chaos_matrix_cluster_soak(tmp_path):
+    """Replica kill + identity outage + device hang through one live
+    2-replica cluster under CRUD churn, with the journal-exact oracle
+    over every routed decision."""
+    from access_control_srv_tpu.srv.identity import MockIdentityServer
+    from access_control_srv_tpu.srv.transport_grpc import GrpcClient
+
+    ids = MockIdentityServer()
+    for name in ("base", "out") + tuple(f"rec{i}" for i in range(10)):
+        ids.register(f"chaos-tok-{name}", {
+            "id": "chaos-ada",
+            "tokens": [{"token": f"chaos-tok-{name}", "interactive": True}],
+            "role_associations": [
+                {"role": "superadministrator-r-id", "attributes": []}
+            ],
+        })
+    cluster = LocalCluster(
+        n_replicas=2,
+        seed_cfg=seed_paths(),
+        router_cfg={"health_interval_s": 0.3, "max_retries": 1},
+        cfg_extra={
+            "evaluator": {"watchdog": dict(WATCHDOG_CFG)},
+            "client": {"identity": {"address": ids.address,
+                                    "timeout": 2.0}},
+        },
+        base_dir=str(tmp_path),
+        broker_snapshot_every=40,
+    ).start()
+    channel = grpc.insecure_channel(cluster.router.addr)
+    hr_bus = SocketEventBus(cluster.broker_addr)
+    try:
+        create_reader_policy_tree(channel, RULE_ID)
+        wait_converged([r.addr for r in cluster.replicas], timeout_s=45.0,
+                       min_epoch=1)
+
+        # HR rendezvous responder for token-resolved subjects (the
+        # identity phase): replies over the cluster's own broker topic
+        auth_topic = hr_bus.topic("io.restorecommerce.authentication")
+
+        def hr_responder(event_name, message, ctx):
+            if event_name != "hierarchicalScopesRequest":
+                return
+            threading.Thread(target=lambda: auth_topic.emit(
+                "hierarchicalScopesResponse",
+                {"token": message["token"], "subject_id": "chaos-ada",
+                 "interactive": True, "hierarchical_scopes": []},
+            ), daemon=True).start()
+
+        auth_topic.on(hr_responder)
+
+        is_allowed = channel.unary_unary(
+            "/acstpu.AccessControlService/IsAllowed",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.Response.FromString,
+        )
+        stop = threading.Event()
+        records: list = []
+        transport_errors: list = []
+
+        def client_loop():
+            msg = wire_request(role="reader-role")
+            while not stop.is_set():
+                t_send = time.monotonic()
+                try:
+                    resp, call = is_allowed.with_call(msg, timeout=10)
+                except grpc.RpcError as err:
+                    transport_errors.append(
+                        (time.monotonic(), err.code(), err.details())
+                    )
+                    time.sleep(0.02)
+                    continue
+                trailers = dict(call.trailing_metadata() or ())
+                records.append((
+                    t_send, time.monotonic(),
+                    resp.operation_status.code, resp.decision,
+                    int(trailers.get(POLICY_EPOCH_METADATA_KEY, -1)),
+                ))
+                time.sleep(0.004)
+
+        flip_acks: list = []
+        state = {"effect": "PERMIT"}
+
+        def churn_loop():
+            while not stop.is_set():
+                effect = "DENY" if state["effect"] == "PERMIT" else "PERMIT"
+                t_before = time.monotonic()
+                try:
+                    code = upsert_rule(
+                        channel, reader_rule_doc(RULE_ID, effect=effect)
+                    )
+                except grpc.RpcError:
+                    time.sleep(0.05)
+                    continue
+                if code == 200:
+                    flip_acks.append((t_before, time.monotonic()))
+                    state["effect"] = effect
+                time.sleep(0.12)
+
+        client = threading.Thread(target=client_loop, daemon=True)
+        churn = threading.Thread(target=churn_loop, daemon=True)
+        client.start()
+        churn.start()
+
+        # ---- class 1: replica SIGKILL mid-churn ----------------------
+        time.sleep(1.5)
+        cluster.replicas[1].kill()
+        time.sleep(2.0)
+        restarted = cluster.restart_replica(1)
+        wait_converged(
+            [cluster.replicas[0].addr, restarted.addr], timeout_s=60.0,
+        )
+
+        # ---- class 2: identity-service outage ------------------------
+        # baseline: token -> findByToken -> HR rendezvous -> PERMIT
+        # through the router (retry while channels settle post-restart)
+        baseline = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                baseline = is_allowed(
+                    _token_request("chaos-tok-base"), timeout=10
+                )
+            except grpc.RpcError:
+                time.sleep(0.2)
+                continue
+            if baseline.decision == pb.PERMIT:
+                break
+            time.sleep(0.2)
+        assert baseline is not None and baseline.decision == pb.PERMIT, (
+            baseline and (baseline.decision,
+                          baseline.operation_status.code)
+        )
+        addrs = [r.addr for r in cluster.replicas]
+        for addr in addrs:
+            _arm(addr, [{"site": "identity.grpc", "action": "error"}])
+        try:
+            for _ in range(3):  # fresh token: no cache to hide behind
+                try:
+                    resp = is_allowed(
+                        _token_request("chaos-tok-out"), timeout=10
+                    )
+                except grpc.RpcError:
+                    continue
+                # fail closed: an unresolvable subject is NEVER a PERMIT
+                assert resp.decision != pb.PERMIT, (
+                    resp.decision, resp.operation_status.code
+                )
+        finally:
+            for addr in addrs:
+                _clear(addr)
+        recovered = False
+        deadline = time.monotonic() + 30.0
+        attempt = 0
+        while time.monotonic() < deadline and not recovered:
+            try:
+                resp = is_allowed(
+                    _token_request(f"chaos-tok-rec{attempt % 10}"),
+                    timeout=10,
+                )
+                recovered = resp.decision == pb.PERMIT
+            except grpc.RpcError:
+                pass
+            attempt += 1
+            time.sleep(0.3)
+        assert recovered, "identity resolution did not recover"
+
+        # ---- class 3: device hang -> quarantine -> restore ----------
+        # BATCH requests: the unary path serves oracle-first by design
+        # (srv/evaluator.py is_allowed) and never dispatches the device;
+        # only batches reach kernel.evaluate_async and hit the hang
+        victim_addr = cluster.replicas[0].addr
+        _arm(victim_addr, [{"site": "device.materialize",
+                            "action": "hang", "hang_s": 20.0}])
+        direct = GrpcClient(victim_addr)
+        try:
+            deadline = time.monotonic() + 45.0
+            i = 0
+            quarantined = False
+            while time.monotonic() < deadline and not quarantined:
+                # unique resources force decision-cache misses so rows
+                # actually dispatch the kernel (and hit the hang)
+                batch = pb.BatchRequest(requests=[
+                    wire_request(role="reader-role",
+                                 resource_id=f"hang-{i}-{j}")
+                    for j in range(2)
+                ])
+                out = direct.is_allowed_batch(batch)
+                # honest resolution: bounded timeout -> oracle row or
+                # shed; never a transport black hole, never fabricated
+                for resp in out.responses:
+                    assert resp.operation_status.code in \
+                        (200,) + SHED_CODES, resp.operation_status
+                ident = _replica_command(victim_addr, "program_identity")
+                quarantined = bool(ident.get("quarantined"))
+                i += 1
+            assert quarantined, (
+                "device hang never tripped quarantine: "
+                f"{_replica_command(victim_addr, 'faults')}"
+            )
+            status = _replica_command(victim_addr, "faults")
+            assert status["hits_by_site"].get("device.materialize", 0) > 0
+            # quarantined serving stays honest AND fast (oracle path)
+            out = direct.is_allowed_batch(pb.BatchRequest(requests=[
+                wire_request(role="reader-role", resource_id="quar-0")
+            ]))
+            assert out.responses[0].operation_status.code in \
+                (200,) + SHED_CODES
+        finally:
+            _clear(victim_addr)
+        # bounded recovery window: probe re-initializes, kernel returns
+        deadline = time.monotonic() + 45.0
+        restored = False
+        while time.monotonic() < deadline and not restored:
+            ident = _replica_command(victim_addr, "program_identity")
+            restored = (not ident.get("quarantined")
+                        and bool(ident.get("kernel_active")))
+            time.sleep(0.3)
+        assert restored, f"kernel path not restored: {ident}"
+        wd = _replica_command(victim_addr, "health_check").get(
+            "device_watchdog") or {}
+        assert wd.get("restores", 0) >= 1, wd
+        assert wd.get("degraded_seconds", 0) > 0, wd
+
+        # ---- wind down + journal-exact oracle ------------------------
+        time.sleep(0.5)
+        stop.set()
+        client.join(timeout=15)
+        churn.join(timeout=15)
+        assert not client.is_alive() and not churn.is_alive()
+        assert not transport_errors, transport_errors[:5]
+        bad = {code for _, _, code, _, _ in records
+               if code != 200 and code not in SHED_CODES}
+        assert not bad, bad
+        assert len(records) > 100
+        assert len(flip_acks) >= 5
+        _run_oracle(records, flip_acks, cluster.broker_addr)
+    finally:
+        hr_bus.close()
+        channel.close()
+        cluster.stop()
+        ids.stop()
+
+
+# ------------------------------------------------- journal tampering
+
+
+def _journal_path(base_dir: str) -> str:
+    return os.path.join(base_dir, "broker", "broker.journal")
+
+
+def _snapshot_rule_effects(base_dir: str, rule_id: str):
+    """Ordered effects of ``rule_id`` frames inside the broker
+    snapshot's rules topic."""
+    path = os.path.join(base_dir, "broker", "broker.snapshot")
+    blob = json.load(open(path))
+    state = json.loads(blob["state"])
+    out = []
+    for _event, message in state.get("topics", {}).get(RULES_TOPIC, []):
+        doc = (message or {}).get("payload") or {}
+        if doc.get("id") == rule_id:
+            out.append(doc.get("effect"))
+    return out
+
+
+def _tail_rule_lines(path: str, rule_id: str):
+    """(line_index, effect) for every ``rule_id`` emit in the journal
+    tail (CRC-framed lines)."""
+    out = []
+    for i, line in enumerate(open(path).read().splitlines()):
+        body = line[10:] if line.startswith("C") else line
+        try:
+            rec = json.loads(body)
+        except ValueError:
+            continue
+        if rec.get("k") != "emit" or rec.get("t") != RULES_TOPIC:
+            continue
+        doc = ((rec.get("m") or {}).get("payload")) or {}
+        if doc.get("id") == rule_id:
+            out.append((i, doc.get("effect")))
+    return out
+
+
+def _direct_decision(addr: str):
+    from access_control_srv_tpu.srv.transport_grpc import GrpcClient
+
+    client = GrpcClient(addr)
+    try:
+        resp = client.is_allowed(wire_request(role="reader-role"))
+        return resp.decision, resp.operation_status.code
+    finally:
+        client.close()
+
+
+@pytest.mark.chaos(timeout=280)
+def test_journal_tamper_reboot_recovery(tmp_path):
+    """Torn-tail + mid-file corruption classes over cluster reboots on
+    one base_dir, with the snapshot-bounded recovery acceptance: a cold
+    boot from snapshot + tail converges to the same table_fingerprint
+    the full-journal state had before the reboot."""
+    base_dir = str(tmp_path)
+    expected_pb = {"PERMIT": pb.PERMIT, "DENY": pb.DENY}
+
+    def boot():
+        return LocalCluster(
+            n_replicas=2, seed_cfg=seed_paths(), base_dir=base_dir,
+            router_cfg={"health_interval_s": 0.3},
+        ).start()
+
+    # ---- phase A: churn, forced snapshot, known tail ----------------
+    cluster = boot()
+    channel = grpc.insecure_channel(cluster.router.addr)
+    try:
+        create_reader_policy_tree(channel, RULE_ID)
+        effects = ["DENY", "PERMIT", "DENY", "PERMIT", "DENY", "PERMIT"]
+        for effect in effects[:3]:
+            assert upsert_rule(
+                channel, reader_rule_doc(RULE_ID, effect=effect)
+            ) == 200
+        bus = SocketEventBus(cluster.broker_addr)
+        try:
+            status = bus.snapshot()  # compaction point: journal restarts
+            assert status["exists"] and status["tail_records"] == 0
+        finally:
+            bus.close()
+        for effect in effects[3:]:
+            assert upsert_rule(
+                channel, reader_rule_doc(RULE_ID, effect=effect)
+            ) == 200
+        ids = wait_converged([r.addr for r in cluster.replicas],
+                             timeout_s=45.0)
+        identity_a = (ids[0]["policy_epoch"], ids[0]["table_fingerprint"])
+    finally:
+        channel.close()
+        cluster.stop()
+    assert _snapshot_rule_effects(base_dir, RULE_ID)[-1] == effects[2]
+    tail_rules = _tail_rule_lines(_journal_path(base_dir), RULE_ID)
+    assert [e for _, e in tail_rules] == effects[3:]
+
+    # ---- class 4: torn tail (crash mid-append) ----------------------
+    with open(_journal_path(base_dir), "a") as fh:
+        fh.write('C00000000 {"k": "emit", "t": "x"')  # no newline, bad CRC
+    cluster = boot()
+    try:
+        ids = wait_converged([r.addr for r in cluster.replicas],
+                             timeout_s=60.0)
+        # snapshot + tail replay reproduces the pre-reboot program
+        # byte-identically: the torn garbage cost nothing
+        assert (ids[0]["policy_epoch"],
+                ids[0]["table_fingerprint"]) == identity_a
+        bus = SocketEventBus(cluster.broker_addr)
+        try:
+            recovered = bus.snapshot_status()["recovered"]
+        finally:
+            bus.close()
+        assert recovered and recovered.get("dropped_bytes", 0) > 0
+        decision, code = _direct_decision(cluster.replicas[0].addr)
+        assert code == 200 and decision == expected_pb[effects[-1]]
+    finally:
+        cluster.stop()
+
+    # ---- class 5: mid-file corruption -------------------------------
+    # flip bytes inside the LAST chaos-rule record of the tail: replay
+    # must truncate there, landing on the previous flip's effect
+    tail_rules = _tail_rule_lines(_journal_path(base_dir), RULE_ID)
+    assert len(tail_rules) >= 2
+    corrupt_line, _ = tail_rules[-1]
+    _, surviving_effect = tail_rules[-2]
+    lines = open(_journal_path(base_dir)).read().splitlines(keepends=True)
+    assert f'"{tail_rules[-1][1]}"' in lines[corrupt_line]
+    lines[corrupt_line] = lines[corrupt_line].replace(
+        f'"{tail_rules[-1][1]}"', f'"{tail_rules[-1][1][::-1]}"', 1
+    )
+    open(_journal_path(base_dir), "w").writelines(lines)
+    cluster = boot()
+    try:
+        ids = wait_converged([r.addr for r in cluster.replicas],
+                             timeout_s=60.0)
+        # both replicas converge on the journal-exact truncated state
+        bus = SocketEventBus(cluster.broker_addr)
+        try:
+            recovered = bus.snapshot_status()["recovered"]
+        finally:
+            bus.close()
+        assert recovered and recovered.get("dropped_bytes", 0) > 0
+        decision, code = _direct_decision(cluster.replicas[0].addr)
+        assert code == 200 and decision == expected_pb[surviving_effect]
+        decision, code = _direct_decision(cluster.replicas[1].addr)
+        assert code == 200 and decision == expected_pb[surviving_effect]
+    finally:
+        cluster.stop()
+
+
+# --------------------------------------------------- adapter flapping
+
+
+GQL_BODY = json.dumps({
+    "data": {"op": {"details": [{"payload": {"id": "res-1"}}]}}
+}).encode()
+
+
+class _GqlHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(GQL_BODY)))
+        self.end_headers()
+        self.wfile.write(GQL_BODY)
+
+    def log_message(self, *args):
+        pass
+
+
+def test_adapter_flap_per_row_honest_and_recovers():
+    """Class 6: a flapping context-query upstream (``adapter.http``
+    armed with a Bernoulli schedule) yields per-row transport errors
+    only — every successful row carries the true payload, no row is
+    fabricated — and the adapter fully recovers once the flap clears."""
+    from access_control_srv_tpu.core.errors import (
+        ContextQueryTransportError,
+    )
+    from access_control_srv_tpu.srv.adapters import GraphQLAdapter
+    from access_control_srv_tpu.srv.faults import REGISTRY
+
+    from access_control_srv_tpu.models import Request, Target
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _GqlHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}/graphql"
+    adapter = GraphQLAdapter(url)
+    cq = SimpleNamespace(query="query q { all { id } }", filters=[])
+    req = Request(target=Target(subjects=[], resources=[], actions=[]),
+                  context={"resources": []})
+    try:
+        with REGISTRY.arm([{"site": "adapter.http", "action": "error",
+                            "p": 0.6}], seed=5):
+            results = adapter.query_many([(cq, req) for _ in range(12)])
+            failed = [r for r in results
+                      if isinstance(r, ContextQueryTransportError)]
+            served = [r for r in results if not isinstance(r, Exception)]
+            assert REGISTRY.hits("adapter.http") > 0
+            # per-row honesty: a row either fails as a transport error
+            # or carries the TRUE upstream payload — nothing in between
+            assert len(failed) + len(served) == 12, results
+            for row in served:
+                assert row == [{"id": "res-1"}]
+        # flap cleared: every row serves
+        results = adapter.query_many([(cq, req) for _ in range(6)])
+        assert results == [[{"id": "res-1"}]] * 6
+    finally:
+        adapter.close()
+        server.shutdown()
+        server.server_close()

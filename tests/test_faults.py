@@ -1,0 +1,305 @@
+"""Failpoint framework (srv/faults.py) and device watchdog
+(srv/watchdog.py) unit tests: deterministic schedules, action semantics,
+hang release, and the timeout -> quarantine -> probe -> restore cycle
+against a scripted fake evaluator."""
+
+import threading
+import time
+
+import pytest
+
+from access_control_srv_tpu.srv.faults import (
+    FailpointRegistry,
+    Failpoint,
+    FaultError,
+    configure_from,
+)
+from access_control_srv_tpu.srv.watchdog import (
+    DeviceTimeoutError,
+    DeviceWatchdog,
+)
+
+
+# ------------------------------------------------------------ schedules
+
+
+class TestFailpointSchedule:
+    def _hits(self, spec, n, seed=0):
+        point = Failpoint(spec, seed=seed)
+        return [i for i in range(n) if point.evaluate()]
+
+    def test_default_hits_every_call(self):
+        assert self._hits({"site": "s"}, 5) == [0, 1, 2, 3, 4]
+
+    def test_after_skips_prefix(self):
+        assert self._hits({"site": "s", "after": 3}, 6) == [3, 4, 5]
+
+    def test_every_strides(self):
+        assert self._hits({"site": "s", "every": 3}, 9) == [0, 3, 6]
+
+    def test_after_plus_every(self):
+        assert self._hits({"site": "s", "after": 2, "every": 2}, 8) == \
+            [2, 4, 6]
+
+    def test_count_caps_hits(self):
+        assert self._hits({"site": "s", "count": 2}, 10) == [0, 1]
+
+    def test_p_is_deterministic_per_seed(self):
+        spec = {"site": "s", "p": 0.5}
+        a = self._hits(spec, 50, seed=7)
+        b = self._hits(spec, 50, seed=7)
+        c = self._hits(spec, 50, seed=8)
+        assert a == b
+        assert a != c  # a different seed draws a different stream
+        assert 0 < len(a) < 50
+
+    def test_p_stream_is_per_site(self):
+        # the schedule of one site must not depend on another's call rate
+        a = Failpoint({"site": "a", "p": 0.5}, seed=3)
+        hits_alone = [i for i in range(30) if a.evaluate()]
+        a2 = Failpoint({"site": "a", "p": 0.5}, seed=3)
+        b = Failpoint({"site": "b", "p": 0.5}, seed=3)
+        hits_interleaved = []
+        for i in range(30):
+            b.evaluate()
+            if a2.evaluate():
+                hits_interleaved.append(i)
+        assert hits_alone == hits_interleaved
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            Failpoint({"site": "s", "action": "explode"})
+
+
+# ------------------------------------------------------------- registry
+
+
+class TestFailpointRegistry:
+    def test_disarmed_fire_is_noop(self):
+        reg = FailpointRegistry()
+        assert reg.enabled is False
+        assert reg.fire("anything") is None
+
+    def test_error_action_raises_fault_error(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "error"}])
+        with pytest.raises(FaultError) as err:
+            reg.fire("s")
+        assert err.value.site == "s"
+        assert "fault injected at s" in str(err.value)
+
+    def test_error_action_uses_exc_factory(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "error"}])
+
+        class Domain(Exception):
+            pass
+
+        with pytest.raises(Domain):
+            reg.fire("s", exc=Domain)
+
+    def test_unarmed_site_misses(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s"}])
+        assert reg.fire("other") is None
+
+    def test_delay_action_sleeps(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "delay", "delay_s": 0.05}])
+        t0 = time.monotonic()
+        hit = reg.fire("s")
+        assert hit is not None and hit.action == "delay"
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_hang_bounded_by_hang_s(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "hang", "hang_s": 0.05}])
+        t0 = time.monotonic()
+        reg.fire("s")
+        assert 0.04 <= time.monotonic() - t0 < 5.0
+
+    def test_clear_releases_hangers(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "hang", "hang_s": 30.0}])
+        released = threading.Event()
+
+        def hanger():
+            reg.fire("s")
+            released.set()
+
+        thread = threading.Thread(target=hanger, daemon=True)
+        thread.start()
+        time.sleep(0.05)
+        assert not released.is_set()
+        reg.clear()
+        assert released.wait(5.0)
+        thread.join(5.0)
+
+    def test_tear_truncates_bytes(self):
+        reg = FailpointRegistry()
+        reg.configure([{"site": "s", "action": "torn", "torn_frac": 0.5}])
+        data = b"x" * 100
+        assert reg.tear("s", data) == b"x" * 50
+
+    def test_tear_passthrough_when_disarmed(self):
+        reg = FailpointRegistry()
+        data = b"record\n"
+        assert reg.tear("s", data) == data
+
+    def test_stats_and_hits(self):
+        reg = FailpointRegistry()
+        reg.configure(
+            [{"site": "s", "action": "delay", "delay_s": 0.0, "every": 2}],
+            seed=9,
+        )
+        for _ in range(4):
+            reg.fire("s")
+        stats = reg.stats()
+        assert stats["enabled"] is True
+        assert stats["seed"] == 9
+        assert stats["hits_by_site"] == {"s": 2}
+        assert reg.hits("s") == 2
+        assert reg.hits("other") == 0
+        (point,) = stats["points"]
+        assert point["calls"] == 4 and point["hits"] == 2
+
+    def test_arm_context_manager_clears_on_exit(self):
+        reg = FailpointRegistry()
+        with reg.arm([{"site": "s"}]):
+            assert reg.enabled
+            with pytest.raises(FaultError):
+                reg.fire("s")
+        assert not reg.enabled
+        assert reg.fire("s") is None
+
+    def test_on_hit_hook_counts_and_never_injects(self):
+        reg = FailpointRegistry()
+        seen = []
+        reg.on_hit = seen.append
+        reg.configure([{"site": "s", "action": "delay", "delay_s": 0.0}])
+        reg.fire("s")
+        assert seen == ["s"]
+
+        def broken(site):
+            raise RuntimeError("metrics down")
+
+        reg.on_hit = broken
+        assert reg.fire("s") is not None  # hook errors are swallowed
+
+    def test_configure_from_block(self):
+        reg_points = [{"site": "s", "action": "delay"}]
+        assert configure_from(None) is False
+        assert configure_from({"enabled": False,
+                               "points": reg_points}) is False
+        from access_control_srv_tpu.srv.faults import REGISTRY
+
+        try:
+            assert configure_from({"enabled": True, "seed": 3,
+                                   "points": reg_points}) is True
+            assert REGISTRY.stats()["seed"] == 3
+        finally:
+            REGISTRY.clear()
+
+
+# ------------------------------------------------------------- watchdog
+
+
+class FakeEvaluator:
+    """Scripted evaluator facade: the watchdog only needs
+    attach_watchdog / set_quarantined / refresh / kernel_probe."""
+
+    def __init__(self):
+        self.quarantined_calls = []
+        self.refreshes = 0
+        self.probes = 0
+        self.probe_ok = True
+        self.refresh_ok = True
+
+    def attach_watchdog(self, watchdog):
+        self.watchdog = watchdog
+
+    def set_quarantined(self, flag):
+        self.quarantined_calls.append(bool(flag))
+
+    def refresh(self, wait=False):
+        self.refreshes += 1
+        if not self.refresh_ok:
+            raise RuntimeError("refresh failed")
+
+    def kernel_probe(self):
+        self.probes += 1
+        if not self.probe_ok:
+            raise RuntimeError("probe failed")
+        return True
+
+
+def _watchdog(ev, **over):
+    cfg = {"window_s": 30.0, "min_volume": 1, "failure_ratio": 0.5,
+           "open_s": 0.05, "half_open_probes": 1}
+    kw = {"materialize_timeout_s": 0.1, "probe_interval_s": 0.05,
+          "breaker_cfg": cfg}
+    kw.update(over)
+    return DeviceWatchdog(ev, **kw)
+
+
+class TestDeviceWatchdog:
+    def test_run_passes_through_result(self):
+        ev = FakeEvaluator()
+        wd = _watchdog(ev)
+        try:
+            assert wd.run(lambda: ("d", "c", "s")) == ("d", "c", "s")
+            assert wd.status()["timeouts"] == 0
+        finally:
+            wd.close()
+
+    def test_run_relays_callable_errors(self):
+        ev = FakeEvaluator()
+        wd = _watchdog(ev)
+        try:
+            with pytest.raises(ValueError):
+                wd.run(lambda: (_ for _ in ()).throw(ValueError("bad")))
+        finally:
+            wd.close()
+
+    def test_timeout_raises_and_quarantines(self):
+        ev = FakeEvaluator()
+        ev.probe_ok = False  # keep the probe failing: stay quarantined
+        wd = _watchdog(ev)
+        try:
+            wedge = threading.Event()
+            with pytest.raises(DeviceTimeoutError):
+                wd.run(lambda: wedge.wait(10.0))
+            wedge.set()
+            status = wd.status()
+            assert status["timeouts"] == 1
+            assert status["quarantined"] is True
+            assert ev.quarantined_calls[:1] == [True]
+        finally:
+            wd.close()
+
+    def test_probe_restores_kernel_path(self):
+        ev = FakeEvaluator()
+        wd = _watchdog(ev)
+        try:
+            wedge = threading.Event()
+            with pytest.raises(DeviceTimeoutError):
+                wd.run(lambda: wedge.wait(10.0))
+            wedge.set()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                status = wd.status()
+                if not status["quarantined"]:
+                    break
+                time.sleep(0.02)
+            status = wd.status()
+            assert status["quarantined"] is False
+            assert status["restores"] == 1
+            assert status["degraded_seconds"] > 0.0
+            assert ev.refreshes >= 1 and ev.probes >= 1
+            # quarantine toggled on, then off
+            assert ev.quarantined_calls[0] is True
+            assert ev.quarantined_calls[-1] is False
+            # healthy serving again records breaker successes
+            assert wd.run(lambda: 42) == 42
+        finally:
+            wd.close()

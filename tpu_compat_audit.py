@@ -649,6 +649,52 @@ def main() -> int:
                  "attribution is host-side by construction"),
     })
 
+    # 7b. deterministic fault injection must be host-only: srv/faults.py
+    # may not import jax (it is marked `# acs-lint: host-only`), and a
+    # batch evaluated with the registry ARMED on the device-boundary
+    # sites (device.dispatch / device.materialize, zero-delay schedules
+    # so every call hits) must lower to the BYTE-identical device
+    # program as the unarmed path — failpoints interpose on host control
+    # flow AROUND the dispatch, never on what the device runs
+    import access_control_srv_tpu.srv.faults as flt_mod
+    from access_control_srv_tpu.srv.faults import REGISTRY as flt_registry
+
+    flt_src = open(flt_mod.__file__).read()
+    flt_imports_jax = re.search(r"^\s*(import|from)\s+jax\b", flt_src, re.M)
+    flt_marked_host_only = "acs-lint: host-only" in flt_src
+    flt_reqs = [_d_request(k) for k in range(12)]
+    with flt_registry.arm([
+        {"site": "device.dispatch", "action": "delay", "delay_s": 0.0},
+        {"site": "device.materialize", "action": "delay", "delay_s": 0.0},
+    ], seed=11):
+        flt_served = hybrid_d.is_allowed_batch(flt_reqs)
+        flt_hits = dict(flt_registry.stats()["hits_by_site"])
+        batch_flt = encode_requests(flt_reqs, hybrid_d._compiled)
+        hlo_faults = _lower_dyn(hybrid_d._compiled, reqs=flt_reqs)
+    faults_ok = (
+        not flt_imports_jax
+        and flt_marked_host_only
+        and len(flt_served) == 12
+        and flt_hits.get("device.dispatch", 0) >= 1
+        and flt_hits.get("device.materialize", 0) >= 1
+        and bool(batch_flt.eligible.all())
+        and hlo_faults == hlo_patched       # byte-identical device program
+    )
+    results.append({
+        "kernel": "failpoints-zero-device-ops",
+        "ok": bool(faults_ok),
+        "imports_jax": bool(flt_imports_jax),
+        "marked_host_only": bool(flt_marked_host_only),
+        "hlo_identical": hlo_faults == hlo_patched,
+        "armed_site_hits": flt_hits,
+        "note": ("batch evaluated with failpoints ARMED on the device "
+                 "dispatch/materialize sites (every call hit) lowers to "
+                 "the BYTE-identical device program as the unarmed path; "
+                 "srv/faults.py never imports jax and carries the "
+                 "acs-lint host-only marker — injection wraps the "
+                 "dispatch on host, the device program is untouched"),
+    })
+
     # 8. deep device pipeline + zero-copy encode: the depth-N pipeline is
     # HOST orchestration only — the device program a batch runs must be
     # byte-identical whether it was dispatched depth-1 (materialize
