@@ -911,6 +911,10 @@ def apply_events(
 
     stats["sets_patched"] = len(affected)
     stats["target_rows_written"] = rows_written
+    # the set SLOTS this patch rewrote: the pod-sharded kernel
+    # (parallel/pod_shard.py) maps slots to owning shards and re-slices
+    # only those, leaving every other shard's host tables untouched
+    stats["patched_slots"] = sorted(ns.sets[sid].slot for sid in affected)
     new_compiled = replace(
         compiled,
         arrays=a,

@@ -856,6 +856,32 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
 def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     """Stages F-G (pre-abort): policy-effect combination per set and the
     last-set-wins decision; shared by both kernels."""
+    set_eff, set_cach, set_any = _per_set_effects(
+        c, contrib_present, contrib_eff, contrib_cach
+    )
+
+    # last-set-wins (reference: :293-295); effect present but neither
+    # PERMIT nor DENY folds to INDETERMINATE with the winning cacheable
+    # (reference: :312-318)
+    S = set_eff.shape[0]
+    s_pos = jnp.arange(S)
+    winner = jnp.max(jnp.where(set_any, s_pos, -1))
+    have = winner >= 0
+    winner_c = jnp.clip(winner, 0, S - 1)
+    decision = jnp.where(have, jnp.take(set_eff, winner_c), 0)
+    cacheable = jnp.where(
+        have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
+    )
+    return decision, cacheable
+
+
+def _per_set_effects(c: dict, contrib_present, contrib_eff, contrib_cach):
+    """Stage F alone: combine each set's policy contributions under its
+    combining algorithm, returning per-set ``(set_eff, set_cach, set_any)``
+    WITHOUT the last-set-wins tail.  Split out so the pod-sharded kernel
+    (parallel/pod_shard.py) can run it shard-locally — whole sets live on
+    one shard — and merge the per-set results across shards with a packed
+    positional pmax instead of the local winner scan."""
     KP = contrib_present.shape[1]
     kp_pos2 = jnp.arange(KP)[None, :]
     p_first_deny = jnp.min(
@@ -878,20 +904,7 @@ def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     s_sel_c = jnp.clip(s_sel, 0, KP - 1)
     set_eff = jnp.take_along_axis(contrib_eff, s_sel_c[:, None], axis=1)[:, 0]
     set_cach = jnp.take_along_axis(contrib_cach, s_sel_c[:, None], axis=1)[:, 0]
-
-    # last-set-wins (reference: :293-295); effect present but neither
-    # PERMIT nor DENY folds to INDETERMINATE with the winning cacheable
-    # (reference: :312-318)
-    S = set_eff.shape[0]
-    s_pos = jnp.arange(S)
-    winner = jnp.max(jnp.where(set_any, s_pos, -1))
-    have = winner >= 0
-    winner_c = jnp.clip(winner, 0, S - 1)
-    decision = jnp.where(have, jnp.take(set_eff, winner_c), 0)
-    cacheable = jnp.where(
-        have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
-    )
-    return decision, cacheable
+    return set_eff, set_cach, set_any
 
 
 def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
@@ -926,12 +939,12 @@ def _evaluate_from_matches(c: dict, r: dict, m: dict, with_acl: bool = True):
     )
 
 
-def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
-                        cond_a, cond_c, pol_gate, set_gate, pol_subject):
-    """Stages E-G: rule-effect combination per policy, policy-effect
-    combination per set, last-set-wins decision and condition aborts —
-    shared tail of every kernel variant."""
-    # -------------------------------------------------- E: combine rule effects
+def _policy_contributions(c: dict, reached, acl_rule, has_cond, cond_t,
+                          cond_a, pol_gate, set_gate, pol_subject):
+    """Stage E alone: per-policy winning-rule contributions plus the
+    abort-rule mask.  Split out of _combine_and_decide so the pod-sharded
+    kernel (parallel/pod_shard.py) can run stages A-F shard-locally —
+    whole sets live on one shard — before its cross-shard collectives."""
     scope = set_gate[:, None, None] & pol_gate[:, :, None]
     abort_rule = reached & has_cond & cond_a & scope
     matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
@@ -974,6 +987,21 @@ def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
     contrib_present = no_rules_contrib | any_coll
     contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
     contrib_cach = jnp.where(no_rules_contrib, c["pol_cacheable"], rule_cach_sel)
+    return contrib_present, contrib_eff, contrib_cach, abort_rule
+
+
+def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
+                        cond_a, cond_c, pol_gate, set_gate, pol_subject):
+    """Stages E-G: rule-effect combination per policy, policy-effect
+    combination per set, last-set-wins decision and condition aborts —
+    shared tail of every kernel variant."""
+    # -------------------------------------------------- E: combine rule effects
+    contrib_present, contrib_eff, contrib_cach, abort_rule = (
+        _policy_contributions(
+            c, reached, acl_rule, has_cond, cond_t, cond_a,
+            pol_gate, set_gate, pol_subject,
+        )
+    )
 
     # --------------------------------------- F-G: combine + last-set-wins
     decision, cacheable = _combine_sets(
@@ -982,16 +1010,16 @@ def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
     status = jnp.int32(200)
 
     # condition aborts preempt everything, first in flat rule order
-    KP = coll.shape[1]
+    S, KP, KR = abort_rule.shape
     flat_order = (
-        jnp.arange(coll.shape[0])[:, None, None] * (KP * KR)
+        jnp.arange(S)[:, None, None] * (KP * KR)
         + jnp.arange(KP)[None, :, None] * KR
         + jnp.arange(KR)[None, None, :]
     )
     abort_pos = jnp.min(jnp.where(abort_rule, flat_order, BIG))
     has_abort = abort_pos < BIG
     # gather the aborting rule's condition code and raw cacheable
-    abort_flat = jnp.clip(abort_pos, 0, coll.size - 1)
+    abort_flat = jnp.clip(abort_pos, 0, abort_rule.size - 1)
     cond_c_flat = cond_c.reshape(-1)
     cach_raw_flat = c["rule_cacheable_raw"].reshape(-1)
     abort_code = jnp.take(cond_c_flat, abort_flat)

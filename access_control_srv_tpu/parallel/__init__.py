@@ -6,14 +6,25 @@ from .cluster import (
     ReplicaProcess,
     maybe_initialize_distributed,
 )
-from .mesh import ShardedDecisionKernel, make_mesh, make_mesh2, pad_batch
+from .mesh import (
+    ShardedDecisionKernel,
+    make_mesh,
+    make_mesh2,
+    pad_batch,
+    resolve_shard_map,
+    wrap_shard_map,
+)
+from .pod_shard import PodShardedKernel
 
 __all__ = [
     "LocalCluster",
+    "PodShardedKernel",
     "ReplicaProcess",
     "ShardedDecisionKernel",
     "make_mesh",
     "make_mesh2",
     "maybe_initialize_distributed",
     "pad_batch",
+    "resolve_shard_map",
+    "wrap_shard_map",
 ]

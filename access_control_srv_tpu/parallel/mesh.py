@@ -29,6 +29,31 @@ from ..ops.encode import RequestBatch
 from ..ops.kernel import _evaluate_one, bake_policy_constants
 
 
+def resolve_shard_map():
+    """The running jax's ``shard_map`` entry point: ``jax.shard_map`` on
+    >= 0.5, ``jax.experimental.shard_map.shard_map`` before.  One probe
+    shared by every sharded kernel (rule_shard, pod_shard) so a jax
+    upgrade changes exactly one call site."""
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # jax < 0.5
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def wrap_shard_map(fn, *, mesh: Mesh, in_specs, out_specs):
+    """``shard_map(fn, ...)`` with replication checking off, spelling the
+    flag for the running jax (``check_vma`` on >= 0.6, ``check_rep``
+    before).  The sharded kernels' cross-device reductions intentionally
+    leave per-device values unreplicated until the packed-key collectives
+    merge them, so the static replication checker must be disabled."""
+    shard_map = resolve_shard_map()
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_vma=False, **kwargs)
+    except TypeError:  # pre-0.6 jax spells the flag check_rep
+        return shard_map(fn, check_rep=False, **kwargs)
+
+
 def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
     devices = jax.devices()
     if n_devices is not None:

@@ -284,11 +284,6 @@ class RuleShardedKernel:
         )
         kr_total = self._kr_total
 
-        # jax < 0.5 exposes shard_map under jax.experimental only
-        shard_map = getattr(jax, "shard_map", None)
-        if shard_map is None:
-            from jax.experimental.shard_map import shard_map
-
         c_specs = {k: P(model_axis) for k in self._c}
 
         def run(c, offsets, batch_arrays, rgx_set, pfx_neq):
@@ -303,15 +298,14 @@ class RuleShardedKernel:
 
             return jax.vmap(one)(batch_arrays)
 
-        sm_kwargs = dict(
+        from .mesh import wrap_shard_map
+
+        wrapped = wrap_shard_map(
+            run,
             mesh=mesh,
             in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
             out_specs=(P(data_axis), P(data_axis), P(data_axis)),
         )
-        try:
-            wrapped = shard_map(run, check_vma=False, **sm_kwargs)
-        except TypeError:  # pre-0.6 jax spells the flag check_rep
-            wrapped = shard_map(run, check_rep=False, **sm_kwargs)
         self._run = jax.jit(wrapped)
 
     def evaluate(self, batch: RequestBatch):

@@ -140,6 +140,13 @@ class CommandInterface:
                     # mutation-to-visibility latency and the active
                     # capacity buckets (ops/delta.py)
                     detail["policy_update"] = evaluator.delta_stats()
+                if hasattr(evaluator, "shard_identity"):
+                    # pod-sharded tier (parallel/pod_shard.py): shard
+                    # count, per-shard fingerprints/capacities and the
+                    # applied-patch watermarks
+                    sharding = evaluator.shard_identity()
+                    if sharding is not None:
+                        detail["sharding"] = sharding
             decision_cache = self.decision_cache
             if decision_cache is None and evaluator is not None:
                 decision_cache = getattr(evaluator, "decision_cache", None)
@@ -318,6 +325,15 @@ class CommandInterface:
         evaluator = self.service.evaluator
         if evaluator is not None and hasattr(evaluator, "table_fingerprint"):
             out["table_fingerprint"] = evaluator.table_fingerprint()
+        if evaluator is not None and hasattr(evaluator, "shard_identity"):
+            # pod-sharded tier: the per-shard fingerprints roll into one
+            # pod fingerprint (already folded into table_fingerprint
+            # above, so the cluster convergence oracle checks it
+            # transparently); the full breakdown rides along for the
+            # audit row and operator drill-down
+            sharding = evaluator.shard_identity()
+            if sharding is not None:
+                out["sharding"] = sharding
         if evaluator is not None:
             # device-health routing state: the chaos harness polls these
             # to assert quarantine entry and kernel-path restore

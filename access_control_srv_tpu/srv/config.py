@@ -70,6 +70,18 @@ DEFAULT_CONFIG: dict = {
         },
     },
     "seed_data": None,
+    # device-mesh layout (srv/worker.py).  data_devices: batch-axis data
+    # parallelism (int, -1/'all').  model_devices: rule-axis sharding
+    # (parallel/rule_shard.py — delta patching disabled).  pod_shards:
+    # set-axis pod sharding (parallel/pod_shard.py, docs/SHARDING.md —
+    # delta patching stays shard-local); mutually exclusive with
+    # model_devices.  On a multi-host pod, boot each process through
+    # cluster:distributed below so jax.devices() spans the pod.
+    "parallel": {
+        "data_devices": None,
+        "model_devices": None,
+        "pod_shards": None,
+    },
     "server": {"transports": [{"provider": "grpc", "addr": "0.0.0.0:50061"}]},
     # db-acs mirrors the reference acs-client decision cache living in
     # Redis DB 5 (reference: cfg/config.json:254-259); flush_cache payloads

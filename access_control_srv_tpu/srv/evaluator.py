@@ -55,6 +55,7 @@ class HybridEvaluator:
         mesh=None,
         mesh_axis: str = "data",
         model_axis: str | None = None,
+        pod_shards: int | None = None,
         decision_cache=None,
         delta_enabled: bool = True,
         observability=None,
@@ -91,6 +92,12 @@ class HybridEvaluator:
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.model_axis = model_axis
+        # pod-sharded tier (parallel/pod_shard.py, config
+        # parallel:pod_shards): the SET axis of one pod-level bucketed
+        # compile shards over ``model_axis`` instead of the rule axis —
+        # unlike the rule-sharded path this one IS delta-patchable
+        # (shard-local relower, see PodShardedKernel.patched)
+        self.pod_shards = pod_shards
         self._version = 0
         self._compiled = None
         self._kernel: Optional[DecisionKernel] = None
@@ -109,9 +116,13 @@ class HybridEvaluator:
         # incremental-update subsystem (ops/delta.py): capacity-bucketed
         # tables + CRUD-event patching.  Disabled on the rule-sharded mesh
         # path (RuleShardedKernel repartitions per compile) and for the
-        # oracle backend (nothing compiled to patch).
+        # oracle backend (nothing compiled to patch).  The pod-sharded
+        # path keeps it ON: PodShardedKernel.patched re-slices only the
+        # shards owning the patched set slots.
         self.delta_enabled = bool(
-            delta_enabled and model_axis is None and backend != "oracle"
+            delta_enabled
+            and (model_axis is None or pod_shards is not None)
+            and backend != "oracle"
         )
         self._caps = None                   # delta_mod.Capacities
         self._delta_state = None            # delta_mod.DeltaState
@@ -227,6 +238,7 @@ class HybridEvaluator:
             compiled = self._compiled
             state = self._delta_state
             claimed = self._version
+            kernel_prev = self._kernel
         tree = self.engine.policy_sets
         try:
             result, patched, new_state, stats = delta_mod.apply_events(
@@ -264,13 +276,27 @@ class HybridEvaluator:
             self._count_delta("delta-noop")
             return True
 
-        from ..ops.prefilter import PrefilteredKernel
+        shards_patched = 0
+        if getattr(kernel_prev, "supports_shard_patch", False):
+            # pod-sharded path: re-slice ONLY the shards owning the
+            # patched set slots; every other shard's host tables are
+            # reused by reference and the jitted shard_map program comes
+            # from the shared registry — zero new XLA compiles
+            patched_slots = stats.get("patched_slots", [])
+            kernel = kernel_prev.patched(patched, patched_slots)
+            shards_patched = len({
+                min(int(s) // kernel_prev.s_local,
+                    kernel_prev.n_shards - 1)
+                for s in patched_slots
+            })
+        else:
+            from ..ops.prefilter import PrefilteredKernel
 
-        kernel = PrefilteredKernel(
-            patched, mesh=self.mesh, axis=self.mesh_axis,
-            telemetry=self.telemetry, dynamic_policies=True,
-            shared_jits=self._shared_jits,
-        )
+            kernel = PrefilteredKernel(
+                patched, mesh=self.mesh, axis=self.mesh_axis,
+                telemetry=self.telemetry, dynamic_policies=True,
+                shared_jits=self._shared_jits,
+            )
         native_encoder = self._make_native_encoder(patched, kernel)
         cand = self._build_candidate_index()
         with self._lock:
@@ -305,6 +331,8 @@ class HybridEvaluator:
             self.telemetry.delta.inc(
                 "sets_patched", int(stats.get("sets_patched", 0))
             )
+            if shards_patched:
+                self.telemetry.delta.inc("shards_patched", shards_patched)
         return True
 
     def _count_delta(self, key: str) -> None:
@@ -326,7 +354,26 @@ class HybridEvaluator:
         caps = self._caps
         if caps is not None:
             out["capacities"] = caps.as_dict()
+        sharding = self.shard_identity()
+        if sharding is not None:
+            out["sharding"] = {
+                "n_shards": sharding["n_shards"],
+                "applied_patches": [
+                    sh["applied_patches"] for sh in sharding["shards"]
+                ],
+            }
         return out
+
+    def shard_identity(self) -> Optional[dict]:
+        """Pod-sharding surface for health_check/program_identity: shard
+        count, per-shard fingerprints/capacities and the applied-patch
+        watermarks; None when the active kernel is not pod-sharded."""
+        kernel = self._kernel
+        if kernel is None or not getattr(
+            kernel, "supports_shard_patch", False
+        ):
+            return None
+        return kernel.shard_identity()
 
     def table_fingerprint(self) -> Optional[str]:
         """Digest of the compiled policy tables: every device array's
@@ -356,6 +403,12 @@ class HybridEvaluator:
         caps = self._caps
         if caps is not None:
             h.update(repr(sorted(caps.as_dict().items())).encode())
+        sharding = self.shard_identity()
+        if sharding is not None:
+            # fold the per-shard table digests in, so replicas must agree
+            # on the SLICED tables too (shard boundaries, compacted
+            # per-shard target subtables), not just the pod-level arrays
+            h.update(sharding["pod_fingerprint"].encode())
         return h.hexdigest()
 
     # ------------------------------------------------------ full compile
@@ -397,7 +450,23 @@ class HybridEvaluator:
             )
         kernel = None
         if compiled.supported and compiled.n_rules > 0:
-            if self.model_axis is not None and self.mesh is not None:
+            if self.pod_shards is not None and self.mesh is not None:
+                # pod-sharded tier (config: parallel:pod_shards): the SET
+                # axis of the bucketed compile partitions over the model
+                # axis with per-shard compacted target subtables; the
+                # shard_map program registers in _shared_jits, so a
+                # recompile with unchanged capacities reuses it
+                from ..parallel.pod_shard import PodShardedKernel
+
+                prev = self._kernel
+                kernel = PodShardedKernel(
+                    compiled, self.mesh,
+                    data_axis=self.mesh_axis,
+                    model_axis=self.model_axis or "model",
+                    shared_jits=self._shared_jits,
+                    prev_t_cap=getattr(prev, "t_cap", 0),
+                )
+            elif self.model_axis is not None and self.mesh is not None:
                 # rule-axis sharding (config: parallel:model_devices):
                 # the compiled tensors partition over the model axis,
                 # requests over the data axis.  Evaluator-level path

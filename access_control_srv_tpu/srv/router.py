@@ -114,6 +114,10 @@ class ReplicaHandle:
         self.draining = False
         self.inflight = 0
         self.policy_epoch = -1
+        # pod-sharded replicas (parallel/pod_shard.py) report a combined
+        # pod fingerprint through program_identity; the router tracks it
+        # per replica so cluster_status exposes shard-level convergence
+        self.pod_fingerprint = None
         self.last_seen = 0.0
         self.calls = 0
         self.failures = 0
@@ -140,6 +144,7 @@ class ReplicaHandle:
             "draining": self.draining,
             "inflight": self.inflight,
             "policy_epoch": self.policy_epoch,
+            "pod_fingerprint": self.pod_fingerprint,
             "breaker": self.breaker.state,
             "calls": self.calls,
             "failures": self.failures,
@@ -250,6 +255,9 @@ class ClusterRouter:
             epoch = payload.get("policy_epoch")
             if isinstance(epoch, int):
                 replica.policy_epoch = max(replica.policy_epoch, epoch)
+            sharding = payload.get("sharding")
+            if isinstance(sharding, dict):
+                replica.pod_fingerprint = sharding.get("pod_fingerprint")
             replica.last_seen = time.monotonic()
             replica.healthy = True
         except Exception:  # noqa: BLE001 — an unreachable replica
@@ -583,11 +591,18 @@ class ClusterRouter:
             retries = self.retries
             unroutable = self.unroutable
         epochs = [r["policy_epoch"] for r in replicas]
+        pod_fps = {
+            r["pod_fingerprint"] for r in replicas
+            if r.get("pod_fingerprint") is not None
+        }
         snap = self.overhead.snapshot()
         return {
             "addr": self.addr,
             "replicas": replicas,
             "converged": len(set(epochs)) <= 1,
+            # pod-sharded replicas only: every replica reporting a pod
+            # fingerprint holds byte-identical per-shard tables
+            "pod_converged": len(pod_fps) <= 1,
             "min_epoch": min(epochs) if epochs else None,
             "max_epoch": max(epochs) if epochs else None,
             "retries": retries,

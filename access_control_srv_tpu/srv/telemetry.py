@@ -508,8 +508,10 @@ class Telemetry:
         # pipeline's identity-RPC amortizer)
         self.identity = Counter()
         # incremental policy-update subsystem (ops/delta.py): delta-patch /
-        # full-compile / noop / fallback counts, and the mutation-to-
-        # visibility latency (CRUD call to kernel swap) per update
+        # full-compile / noop / fallback counts, shard re-slices under the
+        # pod-sharded tier (shards_patched, parallel/pod_shard.py), and
+        # the mutation-to-visibility latency (CRUD call to kernel swap)
+        # per update
         self.delta = Counter()
         self.policy_update_latency = Histogram()
         # admission control (srv/admission.py): admitted / shed /

@@ -252,13 +252,55 @@ class Worker:
 
         n_data_req = parse_devices("parallel:data_devices")
         n_model_req = parse_devices("parallel:model_devices")
+        n_pod_req = parse_devices("parallel:pod_shards")
         if n_model_req == -1:
             raise ValueError(
                 "parallel:model_devices must be an explicit integer "
                 "(the rule-axis shard count is a layout choice, not "
                 "'all available')"
             )
-        if n_model_req and n_model_req > 1:
+        if n_pod_req == -1:
+            raise ValueError(
+                "parallel:pod_shards must be an explicit integer "
+                "(the set-axis shard count is a layout choice, not "
+                "'all available')"
+            )
+        if n_pod_req and n_model_req:
+            raise ValueError(
+                "parallel:pod_shards (set-axis) and "
+                "parallel:model_devices (rule-axis) are mutually "
+                "exclusive layouts for the model mesh axis"
+            )
+        pod_shards = None
+        if n_pod_req:
+            # pod-sharded policy tree (parallel/pod_shard.py): the SET
+            # axis of the bucketed compile shards over the model axis;
+            # delta patching stays shard-local.  Same 2-axis mesh as the
+            # rule-sharded path; n_pod_req == 1 still builds the mesh so
+            # shard-count sweeps exercise one code path.
+            import jax
+
+            from ..parallel import make_mesh2
+
+            avail = len(jax.devices())
+            if n_data_req in (None, -1):
+                n_data = max(1, avail // n_pod_req)
+            else:
+                n_data = max(1, min(n_data_req, avail // n_pod_req))
+            data_axis = cfg.get("parallel:axis", "data")
+            model_axis = cfg.get("parallel:model_axis", "model")
+            mesh = make_mesh2(
+                n_data, n_pod_req,
+                data_axis=data_axis, model_axis=model_axis,
+            )
+            pod_shards = n_pod_req
+            self.logger.info(
+                "pod-sharded mesh active",
+                extra={"data_devices": n_data,
+                       "pod_shards": n_pod_req,
+                       "available": avail},
+            )
+        elif n_model_req and n_model_req > 1:
             import jax
 
             from ..parallel import make_mesh2
@@ -303,6 +345,7 @@ class Worker:
             mesh=mesh,
             mesh_axis=cfg.get("parallel:axis", "data"),
             model_axis=model_axis,
+            pod_shards=pod_shards,
             decision_cache=self.decision_cache,
             delta_enabled=bool(cfg.get("evaluator:delta_enabled", True)),
             observability=self.obs,

@@ -72,7 +72,8 @@ _CONFIG_KNOBS = (
     "OVERLOAD_RULES", "PROFILE_RULES", "PROFILE_BATCH", "PROFILE_CALLS",
     "CLUSTER_BATCH", "CLUSTER_CALLS", "CLUSTER_CLIENTS",
     "CLUSTER_UNARY_PROBES", "DEGRADED_RULES", "DEGRADED_BATCH",
-    "DEGRADED_DURATION_S",
+    "DEGRADED_DURATION_S", "SHARD_RULES", "SHARD_BATCH", "SHARD_CALLS",
+    "SHARD_MUTATIONS", "SHARD_COUNTS",
 )
 
 
@@ -1692,6 +1693,139 @@ def bench_crud_churn():
     )
 
 
+def bench_shard_scale():
+    """Pod-sharded policy tree (parallel/pod_shard.py, docs/SHARDING.md):
+    wire-to-wire decisions/s AND single-rule patch time-to-visibility on
+    one fixed large tree while the set axis sweeps over 1/2/4 shards.
+    The bar is the tentpole claim: sharding the tree must keep serving
+    wire-to-wire through the same worker config (``parallel:pod_shards``)
+    with shard-local patch TTV within 2x of the single-shard point —
+    CRUD visibility must not regress with pod size.  On the CPU fallback
+    every "device" is a host thread slice, so dec/s points carry the
+    [cpu-fallback] annotation and measure overhead, not scaling."""
+    n_rules = int(os.environ.get("SHARD_RULES", 8000))
+    per_call = int(os.environ.get("SHARD_BATCH", 2048))
+    calls = int(os.environ.get("SHARD_CALLS", 6))
+    n_mut = int(os.environ.get("SHARD_MUTATIONS", 6))
+    counts = [int(c) for c in
+              os.environ.get("SHARD_COUNTS", "1,2,4").split(",")]
+
+    # the sweep needs max(counts) devices; on the forced-CPU path they
+    # are virtual host devices, which XLA only materializes when the
+    # flag is set before first backend touch
+    if os.environ.get("BENCH_PLATFORM") == "cpu" \
+            or os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    n_dev = len(jax.devices())
+    skipped = [c for c in counts if c > n_dev]
+    counts = [c for c in counts if c <= n_dev]
+    if skipped:
+        print(f"shard-scale: only {n_dev} devices; skipping shard "
+              f"counts {skipped}", file=sys.stderr, flush=True)
+
+    import statistics
+
+    from access_control_srv_tpu.models import Urns
+    from access_control_srv_tpu.srv.gen import access_control_pb2 as pb
+
+    urns = Urns()
+    rng = np.random.default_rng(23)
+    batch = _serving_batch_msg(per_call, rng, wide=True)
+    points = []
+    for n_shards in counts:
+        worker, server, client = _serving_worker(n_rules, cfg_extra={
+            "parallel": {"pod_shards": n_shards,
+                         "data_devices": max(1, n_dev // n_shards)},
+            **_SERVE_OBSERVABILITY,
+        })
+        try:
+            resp = client.is_allowed_batch(batch)  # warmup (compiles)
+            assert len(resp.responses) == per_call
+            worker.telemetry.stages.clear()
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                client.is_allowed_batch(batch)
+            elapsed = time.perf_counter() - t0
+
+            # shard-local patch TTV: flip one rule's effect, probe until
+            # the decision path has swapped (update + first decision)
+            svc = worker.store.get_resource_service("rule")
+            victims = worker.store.collections["rule"].all()[:n_mut]
+            probe = pb.Request()
+            ttvs = []
+            for doc in victims:
+                doc = dict(doc)
+                doc["effect"] = ("DENY" if doc.get("effect") == "PERMIT"
+                                 else "PERMIT")
+                tgt = doc.get("target") or {}
+                del probe.target.subjects[:]
+                del probe.target.resources[:]
+                del probe.target.actions[:]
+                for a in tgt.get("subjects") or []:
+                    probe.target.subjects.add(id=a["id"], value=a["value"])
+                probe.target.subjects.add(id=urns["subjectID"], value="u0")
+                for a in tgt.get("resources") or []:
+                    probe.target.resources.add(id=a["id"], value=a["value"])
+                for a in tgt.get("actions") or []:
+                    probe.target.actions.add(id=a["id"], value=a["value"])
+                probe.context.subject.value = json.dumps({
+                    "id": "u0",
+                    "role_associations": [
+                        {"role": a["value"], "attributes": []}
+                        for a in tgt.get("subjects") or []
+                        if a["id"] == urns["role"]
+                    ],
+                    "hierarchical_scopes": [],
+                }).encode()
+                t1 = time.perf_counter()
+                svc.update([doc])
+                client.is_allowed(probe)
+                ttvs.append((time.perf_counter() - t1) * 1e3)
+            dstats = worker.evaluator.delta_stats()
+            ident = worker.evaluator.shard_identity() or {}
+            points.append({
+                "pod_shards": n_shards,
+                "data_devices": max(1, n_dev // n_shards),
+                "decisions_per_s": round(per_call * calls / elapsed, 1),
+                "patch_ttv_ms_p50": round(statistics.median(ttvs), 2),
+                "patch_ttv_ms_max": round(max(ttvs), 2),
+                "patches": dstats.get("patches", 0),
+                "full_compiles": dstats.get("full_compiles", 0),
+                "shards_patched": (dstats.get("sharding") or {}).get(
+                    "applied_patches"),
+                "s_local": ident.get("s_local"),
+                "t_bucket": ident.get("t_bucket"),
+                "stage_breakdown": _stage_breakdown(worker.telemetry),
+            })
+        finally:
+            client.close()
+            server.stop()
+            worker.stop()
+
+    base = next((p for p in points if p["pod_shards"] == 1), points[0])
+    worst_ttv = max(p["patch_ttv_ms_p50"] for p in points)
+    return _result(
+        f"pod-sharded patch TTV ratio, widest sweep point vs 1 shard "
+        f"({n_rules}-rule tree)",
+        worst_ttv / max(base["patch_ttv_ms_p50"], 1e-6),
+        "x",
+        {
+            "rules": n_rules, "batch": per_call, "calls": calls,
+            "sweep": points,
+            "devices": n_dev,
+            "bar": "shard-local patch TTV within 2x of the single-shard "
+                   "point; decisions bit-identical to the dense kernel "
+                   "(tests/test_pod_shard.py differential)",
+        },
+    )
+
+
 def bench_overload():
     """Admission-controlled serving at >=4x sustainable offered load
     (srv/admission.py, docs/ADMISSION.md): open-loop generators fire
@@ -2203,8 +2337,8 @@ def main():
                              "serve-latency", "wire-profile",
                              "wire-pipeline", "token-mix",
                              "adapter-mixed", "adapter-mixed-warm",
-                             "crud-churn", "overload", "degraded-mode",
-                             "cluster-scale"]
+                             "crud-churn", "shard-scale", "overload",
+                             "degraded-mode", "cluster-scale"]
     if len(which) > 1 and os.environ.get("BENCH_ISOLATE", "1") != "0":
         # each config in its own process: in-process accumulation across
         # the matrix (JAX allocator state, caches, CPU heat) depresses
@@ -2287,6 +2421,7 @@ def main():
         "adapter-mixed": bench_adapter_mixed,
         "adapter-mixed-warm": bench_adapter_mixed_warm,
         "crud-churn": bench_crud_churn,
+        "shard-scale": bench_shard_scale,
         "overload": bench_overload,
         "degraded-mode": bench_degraded_mode,
         "cluster-scale": bench_cluster_scale,
