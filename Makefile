@@ -1,0 +1,20 @@
+# Convenience targets; verify.sh is the source of truth for the gate.
+
+.PHONY: verify test lint audit bench
+
+verify:
+	./verify.sh
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider \
+	    -p no:xdist -p no:randomly
+
+lint:
+	python -m access_control_srv_tpu.analysis
+
+audit:
+	BENCH_PLATFORM=cpu python tpu_compat_audit.py
+
+bench:
+	python bench_all.py

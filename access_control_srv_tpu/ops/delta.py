@@ -671,6 +671,40 @@ def full_bucketed_compile(
     return padded, caps, state
 
 
+def live_capacities(compiled: CompiledPolicies) -> Capacities:
+    """Unpadded live sizes of a fresh compile — what size-class selection
+    (srv/tenancy.py) measures a tenant tree against."""
+    return Capacities(
+        S=compiled.S, KP=compiled.KP, KR=compiled.KR, T=compiled.T,
+        RV=int(np.asarray(compiled.arrays["hrv_role"]).shape[0]),
+        W=max(len(compiled.entity_vocab), 1),
+    )
+
+
+def fixed_caps_compile(tree, urns: Urns, caps: Capacities,
+                       version: int = 0):
+    """Compile a tree directly into a FIXED capacity class, bypassing
+    ``capacities_for``'s tightness preference.  This is the multi-tenant
+    packing primitive (srv/tenancy.py): every tenant in one size class
+    publishes tables with byte-identical shapes, so the class shares ONE
+    set of jitted executables and per-tenant tables enter as jit
+    arguments.  Raises DeltaIneligible(``capacity-class-<dim>``) when the
+    live tree overflows the class on any dimension — the caller promotes
+    the tenant to the next class and recompiles there."""
+    from .compile import compile_policies
+
+    raw = compile_policies(tree, urns, version=version)
+    if not raw.supported:
+        return raw, None, None
+    live = live_capacities(raw)
+    for dim in ("S", "KP", "KR", "T", "RV", "W"):
+        if getattr(live, dim) > getattr(caps, dim):
+            raise DeltaIneligible(f"capacity-class-{dim}")
+    padded = pad_compiled(raw, caps)
+    state = build_state(padded, raw, tree, caps)
+    return padded, caps, state
+
+
 # ------------------------------------------------------------- delta patcher
 
 

@@ -47,6 +47,7 @@ from __future__ import annotations
 # runtime (tpu_compat_audit row admission-zero-device-ops)
 
 import random
+import re
 import threading
 import time
 from typing import Optional
@@ -75,6 +76,30 @@ PIPELINE_BATCHES = 3
 # metadata key carrying a per-request timeout for clients that cannot set
 # a native gRPC deadline (the rc-wire analog of grpc-timeout)
 TIMEOUT_METADATA_KEY = "x-acs-timeout-ms"
+
+# metadata key carrying the caller's policy domain (srv/tenancy.py).  The
+# value is attacker-controlled and flows into cache keys, journal frames
+# and Prometheus labels, so only a conservative id shape is accepted —
+# anything else is treated as absent (single-tenant path).
+TENANT_METADATA_KEY = "x-acs-tenant"
+_TENANT_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def valid_tenant_id(value) -> Optional[str]:
+    """``value`` as a tenant id when it matches the accepted shape."""
+    tenant = str(value)
+    return tenant if _TENANT_ID_RE.match(tenant) else None
+
+
+def tenant_from_metadata(grpc_context) -> Optional[str]:
+    """The (validated) ``x-acs-tenant`` metadata value, if any."""
+    try:
+        for key, value in grpc_context.invocation_metadata() or ():
+            if str(key).lower() == TENANT_METADATA_KEY:
+                return valid_tenant_id(value)
+    except Exception:  # noqa: BLE001 — non-grpc test doubles
+        return None
+    return None
 
 
 def overload_response(code: int, message: str) -> Response:
@@ -374,6 +399,11 @@ class AdmissionController:
         drain_deadline_s: float = 5.0,
         bulk_interval: int = 4,
         pipeline_depth: int = PIPELINE_BATCHES - 1,
+        tenant_enabled: bool = False,
+        tenant_max_inflight: int = 256,
+        tenant_default_weight: float = 1.0,
+        tenant_weights: Optional[dict] = None,
+        tenant_contention_ratio: float = 0.5,
         telemetry=None,
         time_fn=time.monotonic,
     ):
@@ -408,7 +438,17 @@ class AdmissionController:
         self._stats = {  # guarded-by: _lock
             "admitted": 0, "shed_queue_full": 0, "deadline_rejected": 0,
             "deadline_expired": 0, "shed_shutdown": 0,
+            "shed_tenant_quota": 0, "shed_tenant_fair_share": 0,
         }
+        # per-tenant quotas: inflight caps + weighted fair sharing over the
+        # interactive queue.  All of it is skipped when the request carries
+        # no tenant id, keeping the single-tenant path byte-identical.
+        self.tenant_enabled = bool(tenant_enabled)
+        self.tenant_max_inflight = int(tenant_max_inflight)
+        self.tenant_default_weight = float(tenant_default_weight)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_contention_ratio = float(tenant_contention_ratio)
+        self._tenant_depth: dict[str, int] = {}  # guarded-by: _lock
         self.breakers: dict[str, CircuitBreaker] = {}  # guarded-by: _lock
 
     # ----------------------------------------------------------- construction
@@ -434,6 +474,19 @@ class AdmissionController:
             pipeline_depth=(cfg.get("evaluator") or {}).get(
                 "pipeline_depth", PIPELINE_BATCHES - 1
             ) if hasattr(cfg, "get") else PIPELINE_BATCHES - 1,
+            tenant_enabled=bool((block.get("tenant") or {}).get(
+                "enabled", True
+            )),
+            tenant_max_inflight=(block.get("tenant") or {}).get(
+                "max_inflight_per_tenant", 256
+            ),
+            tenant_default_weight=(block.get("tenant") or {}).get(
+                "default_weight", 1.0
+            ),
+            tenant_weights=(block.get("tenant") or {}).get("weights"),
+            tenant_contention_ratio=(block.get("tenant") or {}).get(
+                "contention_ratio", 0.5
+            ),
             telemetry=telemetry,
         )
         controller._breaker_cfg = dict(block.get("breakers") or {})
@@ -473,12 +526,60 @@ class AdmissionController:
 
     # -------------------------------------------------------------- admission
 
-    def admit(self, cls: str, deadline: Optional[float] = None
-              ) -> Optional[Response]:
+    def tenant_weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(
+            tenant, self.tenant_default_weight
+        ))
+
+    def _tenant_shed(self, cls: str, tenant: str  # holds: _lock NOT held
+                     ) -> Optional[Response]:
+        """Per-tenant quota gate: inflight cap always, weighted fair
+        share only once the class queue is contended — an uncontended
+        queue lets one tenant use the whole depth (work-conserving)."""
+        with self._lock:
+            mine = self._tenant_depth.get(tenant, 0)
+            if mine >= self.tenant_max_inflight:
+                verdict = "quota"
+            else:
+                verdict = None
+                total = self._depth[cls]
+                contended = total >= (
+                    self.max_queue[cls] * self.tenant_contention_ratio
+                )
+                if contended and cls == INTERACTIVE:
+                    # fair bound: this tenant's weight share of the queue
+                    # over the weights of every tenant currently holding
+                    # slots (including this one)
+                    active = set(
+                        t for t, d in self._tenant_depth.items() if d > 0
+                    )
+                    active.add(tenant)
+                    total_w = sum(self.tenant_weight(t) for t in active)
+                    share = self.tenant_weight(tenant) / max(total_w, 1e-9)
+                    bound = max(1, int(self.max_queue[cls] * share))
+                    if mine >= bound:
+                        verdict = "fair_share"
+        if verdict is None:
+            return None
+        self._count(f"shed_tenant_{verdict}")
+        tenant_inc = getattr(self.telemetry, "tenant_inc", None) \
+            if self.telemetry is not None else None
+        if tenant_inc is not None:
+            tenant_inc("shed", tenant)
+        reason = (
+            f"tenant {tenant} inflight cap ({self.tenant_max_inflight})"
+            if verdict == "quota"
+            else f"tenant {tenant} over fair share of {cls} queue"
+        )
+        return overload_response(OVERLOAD_CODE, reason)
+
+    def admit(self, cls: str, deadline: Optional[float] = None,
+              tenant: Optional[str] = None) -> Optional[Response]:
         """Admission decision for one request of traffic class ``cls``:
         None admits (depth incremented — pair with ``release``), a
         Response is the shed envelope to resolve the caller with
-        immediately."""
+        immediately.  ``tenant`` engages the per-tenant quota gates; None
+        skips them entirely (byte-identical single-tenant path)."""
         if not self.enabled:
             return None
         # acs-lint: ignore[guarded-by] benign racy read of a one-way flag:
@@ -487,6 +588,10 @@ class AdmissionController:
         if self._draining:
             self._count("shed_shutdown")
             return overload_response(SHUTDOWN_CODE, "shutting down")
+        if tenant is not None and self.tenant_enabled:
+            shed = self._tenant_shed(cls, tenant)
+            if shed is not None:
+                return shed
         if deadline is not None:
             remaining = deadline - self._time()
             ewma = self._ewma[cls]
@@ -530,6 +635,10 @@ class AdmissionController:
                 self._depth[cls] = depth + 1
                 if self._depth[cls] > self._max_depth_seen[cls]:
                     self._max_depth_seen[cls] = self._depth[cls]
+                if tenant is not None and self.tenant_enabled:
+                    self._tenant_depth[tenant] = (
+                        self._tenant_depth.get(tenant, 0) + 1
+                    )
         if shed:
             self._count("shed_queue_full")
             return overload_response(
@@ -545,12 +654,21 @@ class AdmissionController:
                 )
         return None
 
-    def release(self, cls: str, n: int = 1) -> None:
+    def release(self, cls: str, n: int = 1,
+                tenant: Optional[str] = None) -> None:
         """The batcher collected ``n`` admitted rows off the queue."""
         if n <= 0:
             return
         with self._lock:
             self._depth[cls] = max(0, self._depth[cls] - n)
+            if tenant is not None and tenant in self._tenant_depth:
+                left = self._tenant_depth[tenant] - n
+                if left > 0:
+                    self._tenant_depth[tenant] = left
+                else:
+                    # drop empty slots so offboarded tenants don't pin
+                    # dict entries forever
+                    del self._tenant_depth[tenant]
 
     def expired(self, n: int = 1) -> None:
         """``n`` admitted rows were dropped at dispatch with an expired
@@ -639,6 +757,12 @@ class AdmissionController:
                 "max_queue": dict(self.max_queue),
                 "adaptive_max_batch": self._adaptive_max,
             }
+            if self.tenant_enabled and self._tenant_depth:
+                top = sorted(
+                    self._tenant_depth.items(),
+                    key=lambda kv: kv[1], reverse=True,
+                )[:8]
+                out["tenant_queue_depth"] = dict(top)
             breakers = dict(self.breakers)
         out["batch_latency_estimate_ms"] = {
             cls: round(ewma.estimate() * 1e3, 3)

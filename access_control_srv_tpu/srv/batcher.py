@@ -102,6 +102,10 @@ class MicroBatcher:
         self._inflight: list = []  # evaluation futures, FIFO
         self._last_batch = 0  # previous round's size (regime detector)
         self._rounds_since_bulk = 0
+        # multi-tenant registry (srv/tenancy.TenantRegistry), wired by the
+        # worker when the ``tenancy`` config block is enabled.  None keeps
+        # every row — tenant-tagged or not — on the default-domain path.
+        self.tenancy = None
 
     def start(self) -> None:
         if self._thread is None:
@@ -151,20 +155,30 @@ class MicroBatcher:
         self._fail_queued(self._bulk, BULK)
 
     def _fail_queued(self, q: "queue.Queue", cls: str) -> None:
-        n = 0
+        items = []
         while True:
             try:
-                _, future, _ = q.get_nowait()
+                items.append(q.get_nowait())
             except queue.Empty:
                 break
-            n += 1
+        for _, future, _ in items:
             if not future.done():
                 future.set_result(
                     self._shutdown_result(cls)
                 )
-        if n and self.admission is not None:
-            self.admission.release(cls, n)
-            self.admission.shed_shutdown(n)
+        if items and self.admission is not None:
+            self._release(cls, items)
+            self.admission.shed_shutdown(len(items))
+
+    def _release(self, cls: str, items: list) -> None:
+        """Release admission slots for collected rows — grouped per
+        tenant so the quota ledger tracks the class ledger exactly."""
+        counts: dict = {}
+        for req, _, _ in items:
+            tenant = getattr(req, "_tenant", None)
+            counts[tenant] = counts.get(tenant, 0) + 1
+        for tenant, n in counts.items():
+            self.admission.release(cls, n, tenant=tenant)
 
     @staticmethod
     def _shutdown_result(cls: str):
@@ -216,7 +230,10 @@ class MicroBatcher:
         tracer = self.obs.tracer if self.obs is not None else None
         if self.admission is not None:
             t0 = time.perf_counter() if tracer is not None else 0.0
-            shed = self.admission.admit(INTERACTIVE, deadline)
+            shed = self.admission.admit(
+                INTERACTIVE, deadline,
+                tenant=getattr(request, "_tenant", None),
+            )
             if tracer is not None:
                 from .tracing import STAGE_ADMISSION
 
@@ -245,7 +262,9 @@ class MicroBatcher:
             future.set_result(self._shutdown_result(BULK))
             return future
         if self.admission is not None:
-            shed = self.admission.admit(BULK, deadline)
+            shed = self.admission.admit(
+                BULK, deadline, tenant=getattr(request, "_tenant", None),
+            )
             if shed is not None:
                 future.set_result(ReverseQuery(
                     policy_sets=[], obligations=[],
@@ -349,10 +368,37 @@ class MicroBatcher:
 
     def _dispatch_interactive(self, batch: list) -> None:
         if self.admission is not None:
-            self.admission.release(INTERACTIVE, len(batch))
+            self._release(INTERACTIVE, batch)
             batch = self._drop_expired(batch)
             if not batch:
                 return
+        # tenant partition: rows tagged with a tenant id (and a registry
+        # to serve them) peel off to their tenant's evaluator — one
+        # collection window mixes tenants, the device sees one kernel
+        # call per tenant group on the class-shared program.  With no
+        # registry or no tags this is a no-op and the batch flows down
+        # the exact single-tenant path.
+        if self.tenancy is not None:
+            groups: dict = {}
+            default_rows = []
+            for item in batch:
+                tenant = getattr(item[0], "_tenant", None)
+                if tenant is None:
+                    default_rows.append(item)
+                else:
+                    groups.setdefault(tenant, []).append(item)
+            if groups:
+                while len(self._inflight) >= self._inflight_bound():
+                    self._inflight.pop(0).result()
+                self._inflight = [
+                    f for f in self._inflight if not f.done()
+                ]
+                self._inflight.append(
+                    self._eval_pool.submit(self._eval_tenants, groups)
+                )
+                if not default_rows:
+                    return
+                batch = default_rows
         tracer = self.obs.tracer if self.obs is not None else None
         if tracer is not None:
             from .tracing import STAGE_QUEUE_WAIT
@@ -530,7 +576,7 @@ class MicroBatcher:
         if not items:
             return
         if self.admission is not None:
-            self.admission.release(BULK, len(items))
+            self._release(BULK, items)
             items = self._drop_expired_bulk(items)
         if not items:
             return
@@ -582,11 +628,76 @@ class MicroBatcher:
                 INTERACTIVE, time.perf_counter() - t0, len(batch)
             )
 
+    def _eval_tenants(self, groups: dict) -> None:
+        """Evaluate tenant-tagged rows group-by-group on the eval worker;
+        each group resolves against its own tenant's tables through the
+        tenancy registry (class-shared jitted program, per-tenant table
+        arguments).  Unknown tenants get an honest INDETERMINATE — never
+        a default-domain decision (that would be an isolation leak)."""
+        t0 = time.perf_counter()
+        total = 0
+        tenant_inc = getattr(
+            getattr(self.tenancy, "telemetry", None), "tenant_inc", None
+        )
+        for tenant, items in groups.items():
+            if self.admission is not None:
+                items = self._drop_expired(
+                    items,
+                    margin_s=self.admission.estimate_high(INTERACTIVE),
+                )
+                if not items:
+                    continue
+            total += len(items)
+            try:
+                evaluator = self.tenancy.evaluator_for(tenant)
+            except Exception:  # noqa: BLE001 — registry must not poison rows
+                evaluator = None
+            if evaluator is None:
+                from .tenancy import unknown_tenant_response
+
+                for _, future, _ in items:
+                    if not future.done():
+                        future.set_result(unknown_tenant_response(tenant))
+                continue
+            requests = [req for req, _, _ in items]
+            prepare = getattr(evaluator, "prepare_batch", None)
+            if prepare is not None:
+                try:
+                    prepare(requests)
+                except Exception:
+                    pass
+            responses = None
+            if len(items) >= self.min_kernel_batch:
+                try:
+                    responses = evaluator.is_allowed_batch(requests)
+                except Exception:
+                    responses = None
+            if responses is not None:
+                for (_, future, _), response in zip(items, responses):
+                    future.set_result(response)
+            else:
+                for req, future, _ in items:
+                    try:
+                        future.set_result(evaluator.is_allowed(req))
+                    except Exception as err:
+                        if not future.done():
+                            future.set_exception(err)
+            if tenant_inc is not None:
+                tenant_inc("decision", tenant, len(items))
+        if total and self.admission is not None:
+            self.admission.observe_batch(
+                INTERACTIVE, time.perf_counter() - t0, total
+            )
+
     def _eval_bulk(self, items: list) -> None:
         """Evaluate one bulk (reverse-query) round on the eval worker."""
         t0 = time.perf_counter()
         if self.admission is not None:
             items = self._drop_expired_bulk(items)
+            if not items:
+                return
+        if self.tenancy is not None:
+            items = self._serve_tenant_bulk(items)
             if not items:
                 return
         requests = [req for req, _, _ in items]
@@ -608,3 +719,35 @@ class MicroBatcher:
             self.admission.observe_batch(
                 BULK, time.perf_counter() - t0, len(items)
             )
+
+    def _serve_tenant_bulk(self, items: list) -> list:
+        """Resolve tenant-tagged reverse queries against their tenant's
+        evaluator; returns the untagged remainder for the default path."""
+        default_items = []
+        for item in items:
+            req, future, _ = item
+            tenant = getattr(req, "_tenant", None)
+            if tenant is None:
+                default_items.append(item)
+                continue
+            try:
+                evaluator = self.tenancy.evaluator_for(tenant)
+            except Exception:  # noqa: BLE001
+                evaluator = None
+            if evaluator is None:
+                from .tenancy import unknown_tenant_response
+
+                if not future.done():
+                    future.set_result(ReverseQuery(
+                        policy_sets=[], obligations=[],
+                        operation_status=unknown_tenant_response(
+                            tenant
+                        ).operation_status,
+                    ))
+                continue
+            try:
+                future.set_result(evaluator.what_is_allowed(req))
+            except Exception as err:
+                if not future.done():
+                    future.set_exception(err)
+        return default_items

@@ -174,6 +174,13 @@ class CommandInterface:
                 # log frames reflected in the serving tree) — the router's
                 # per-replica convergence signal (srv/router.py)
                 detail["policy_epoch"] = self.worker.policy_epoch()
+            tenancy = getattr(self.worker, "tenancy", None)
+            if tenancy is not None:
+                # multi-tenant posture: tenant count, size-class
+                # histogram, compiled-program count (the packing claim's
+                # operator signal) and per-tenant epoch top-K
+                # (srv/tenancy.py, docs/MULTITENANT.md)
+                detail["tenancy"] = tenancy.stats()
             watchdog = getattr(self.worker, "watchdog", None)
             if watchdog is not None:
                 # device-health posture: quarantine state, timeout/restore
@@ -220,9 +227,15 @@ class CommandInterface:
         subject cache's Redis-DB-4 analog vs the decision cache's DB-5
         analog, cfg ``redis:db-indexes``); absent db_index flushes both;
         pattern narrows to a subject-id prefix (reference: chassis
-        flush_cache + utils.ts flushACSCache)."""
+        flush_cache + utils.ts flushACSCache).  A ``tenant`` key scopes
+        the decision-cache flush to that tenant's namespace — without it
+        a fleet-wide flush for one tenant's user churn would evict every
+        OTHER tenant's cached decisions too (cross-tenant eviction is
+        both a perf bug and an isolation leak)."""
         data = (payload or {}).get("data", payload) or {}
         pattern = data.get("pattern", "") or ""
+        tenant = data.get("tenant")
+        tenant = str(tenant) if tenant else None
         db_index = data.get("db_index")
         db_subject = int(self.cfg.get("redis:db-indexes:db-subject", 4))
         db_acs = int(self.cfg.get("redis:db-indexes:db-acs", 5))
@@ -247,7 +260,7 @@ class CommandInterface:
             flushed["subject"] = n
             evicted += n
         if self.decision_cache is not None and db_index in (None, db_acs):
-            n = self.decision_cache.evict_pattern(pattern)
+            n = self.decision_cache.evict_pattern(pattern, tenant=tenant)
             flushed["decisions"] = n
             evicted += n
         return {"status": "flushed", "evicted": evicted, "flushed": flushed}
@@ -340,6 +353,16 @@ class CommandInterface:
             out["kernel_active"] = evaluator.kernel_active
             out["quarantined"] = bool(getattr(evaluator, "quarantined",
                                               False))
+        tenancy = getattr(self.worker, "tenancy", None)
+        if tenancy is not None:
+            # per-tenant convergence: replicas that applied the same
+            # tenant journal report the same epoch digest; the fingerprint
+            # map covers evaluators that are built (lazily, on traffic)
+            out["tenancy"] = {
+                "tenant_count": len(tenancy.tenant_ids()),
+                "epoch_digest": tenancy.epoch_digest(),
+                "compiled_programs": tenancy.compiled_program_count(),
+            }
         return out
 
     def faults(self, payload: dict) -> dict:

@@ -133,6 +133,34 @@ DEFAULT_CONFIG: dict = {
             "open_s": 2.0,
             "half_open_probes": 2,
         },
+        # per-tenant quotas (srv/tenancy.py, docs/MULTITENANT.md): an
+        # inflight cap per tenant plus weighted fair sharing of the
+        # interactive queue once it is contended (depth >= max_queue *
+        # contention_ratio).  Only engages for requests carrying a tenant
+        # id; untagged traffic never touches this block.
+        "tenant": {
+            "enabled": True,
+            "max_inflight_per_tenant": 256,
+            "default_weight": 1.0,
+            # tenant id -> weight overrides for weighted fair sharing
+            "weights": {},
+            "contention_ratio": 0.5,
+        },
+    },
+    # multi-tenant serving (srv/tenancy.py, docs/MULTITENANT.md).
+    # Disabled by default: tenant-tagged requests are served from the
+    # default domain exactly as before and no registry object exists.
+    # Enabled: the x-acs-tenant metadata key routes each request to its
+    # tenant's policy domain; tenants bucket onto fixed capacity classes
+    # (SIZE_CLASSES) so same-class tenants share one compiled program per
+    # kernel variant, and tenant CRUD journals through the broker topics
+    # (boot-by-replay onboarding).
+    "tenancy": {
+        "enabled": False,
+        # evaluator backend for tenant domains (defaults to
+        # evaluator:backend)
+        "backend": None,
+        "max_tenants": 100000,
     },
     # observability (srv/tracing.py, docs/OBSERVABILITY.md).  Disabled by
     # default: with enabled false (or the block absent) NO tracer/audit/

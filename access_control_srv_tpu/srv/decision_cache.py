@@ -67,6 +67,12 @@ from ..core.common import get_field as _get
 from ..models.model import OperationStatus, Response
 
 _SEP = "\x1f"  # subject-id / digest separator inside keys
+# tenant prefix separator (multi-tenant serving, srv/tenancy.py): a
+# tenanted key is "<tenant>\x1e<subject>\x1f<digest>", so per-tenant
+# eviction is a prefix walk and an untenanted eviction can never match a
+# tenant's entries (their keys start with the tenant id, and \x1e/\x1f
+# keep an id-equals-subject collision impossible)
+_TSEP = "\x1e"
 
 # how many epoch bumps of footprint history to keep: entries older than
 # the log's reach are treated as globally flushed (conservative)
@@ -188,12 +194,23 @@ def request_fingerprint(request, subject_id_urn: str = "") -> Optional[str]:
         }) if isinstance(context, dict) else _canon(context),
     )
     digest = blake2b(repr(body).encode(), digest_size=16).hexdigest()
-    key = f"{subject_id}{_SEP}{digest}"
+    tenant = getattr(request, "_tenant", None)
+    if tenant:
+        key = f"{tenant}{_TSEP}{subject_id}{_SEP}{digest}"
+    else:
+        key = f"{subject_id}{_SEP}{digest}"
     try:
         request._dc_key = key
     except Exception:  # exotic request objects without attribute support
         pass
     return key
+
+
+def key_tenant(key: Optional[str]) -> Optional[str]:
+    """The tenant a cache key is scoped to (None = default domain)."""
+    if key is None or _TSEP not in key:
+        return None
+    return key.split(_TSEP, 1)[0]
 
 
 class _Shard:
@@ -231,7 +248,10 @@ class DecisionCache:
         self._time = time_fn
         self.telemetry = telemetry
         self._epoch = 0  # guarded-by: _stats_lock
-        # (epoch, footprint-or-None) per bump, newest last; None = global.
+        # (epoch, footprint-or-None, tenant-or-None) per bump, newest
+        # last; footprint None = global flush, tenant None = the default
+        # domain's mutation stream (affects every entry conservatively);
+        # a tenant-tagged bump can only affect that tenant's entries.
         # Bounded: anything older than the log is treated as global.
         self._bumps: deque = deque(maxlen=_BUMP_LOG)  # guarded-by: _stats_lock
         self._stats_lock = threading.Lock()
@@ -249,6 +269,16 @@ class DecisionCache:
             setattr(self, f"_{stat}", getattr(self, f"_{stat}") + by)
         if self.telemetry is not None:
             self.telemetry.cache.inc(stat, by)
+
+    def _tenant_count(self, kind: str, key: Optional[str]) -> None:
+        """Per-tenant cache attribution (cardinality-bounded, see
+        srv/telemetry.TenantCounter); no-op for default-domain keys."""
+        tenant = key_tenant(key)
+        if tenant is None or self.telemetry is None:
+            return
+        tenant_inc = getattr(self.telemetry, "tenant_inc", None)
+        if tenant_inc is not None:
+            tenant_inc(kind, tenant)
 
     def stats(self) -> dict:
         with self._stats_lock:
@@ -300,26 +330,34 @@ class DecisionCache:
         return self._shards[hash(key) & self._mask]
 
     def _affected_between(self, entry_epoch: int,
-                          features) -> bool:
+                          features, tenant=None) -> bool:
         """True when any epoch bump AFTER ``entry_epoch`` could have
         changed a decision with these request features: global bumps
         always count, scoped bumps count when their footprint intersects.
         Feature-less entries (pre-delta callers) are affected by every
-        bump — identical to the original epoch semantics."""
+        bump — identical to the original epoch semantics.
+
+        ``tenant`` is the entry's tenant scope (from its key prefix): a
+        bump tagged with a DIFFERENT tenant can only have touched that
+        tenant's tables and is skipped outright — one tenant's CRUD churn
+        never invalidates another tenant's (or the default domain's) warm
+        set.  Untenanted bumps stay conservative and affect everything."""
         # acs-lint: ignore[guarded-by] epoch snapshot read: atomic int load; staleness re-checked against the bump log below
         current = self._epoch
         if entry_epoch == current:
             return False
-        if entry_epoch > current or features is None:
+        if entry_epoch > current:
             return True
         with self._stats_lock:
             bumps = list(self._bumps)
         covered = current
-        for epoch, footprint in reversed(bumps):
+        for epoch, footprint, bump_tenant in reversed(bumps):
             if epoch <= entry_epoch:
                 break
             covered = epoch
-            if footprint is None:
+            if bump_tenant is not None and bump_tenant != tenant:
+                continue  # another tenant's mutation: provably disjoint
+            if footprint is None or features is None:
                 return True
             try:
                 if footprint.affects(features):
@@ -346,16 +384,19 @@ class DecisionCache:
             entry = shard.entries.get(key)
             if entry is None:
                 self._count("misses")
+                self._tenant_count("cache_miss", key)
                 return None
             (decision, obligations, cacheable, code, message, ent_epoch,
              exp, features) = entry
             if exp <= now or (
                 ent_epoch != epoch
-                and self._affected_between(ent_epoch, features)
+                and self._affected_between(ent_epoch, features,
+                                           key_tenant(key))
             ):
                 del shard.entries[key]
                 self._count("evictions")
                 self._count("misses")
+                self._tenant_count("cache_miss", key)
                 return None
             if ent_epoch != epoch:
                 # scoped survivor: every bump since the entry was written
@@ -365,6 +406,7 @@ class DecisionCache:
                 self._count("scoped_survivors")
             shard.entries.move_to_end(key)
         self._count("hits")
+        self._tenant_count("cache_hit", key)
         # rebuild per hit: callers may hold the Response across a later
         # eviction, so entries never hand out shared mutable state beyond
         # the (treated-as-immutable) obligation attributes
@@ -412,7 +454,8 @@ class DecisionCache:
         # acs-lint: ignore[guarded-by] epoch snapshot reads: atomic int loads; a concurrent bump makes the entry born-stale, never served fresh
         ent_epoch = self._epoch if epoch is None else int(epoch)
         if ent_epoch != self._epoch:  # acs-lint: ignore[guarded-by] epoch snapshot read (see above)
-            if self._affected_between(ent_epoch, features):
+            if self._affected_between(ent_epoch, features,
+                                      key_tenant(key)):
                 return False
             ent_epoch = self._epoch  # acs-lint: ignore[guarded-by] epoch snapshot read (see above)
         entry = (
@@ -437,28 +480,30 @@ class DecisionCache:
 
     # ---------------------------------------------------------- invalidation
 
-    def bump_epoch(self) -> int:
+    def bump_epoch(self, tenant: Optional[str] = None) -> int:
         """Logical full flush: policy-tree mutations (CRUD hot-sync,
         restore/reset/config_update) call this; stale entries become misses
-        immediately and are collected lazily."""
-        return self._bump(None)
+        immediately and are collected lazily.  A ``tenant`` tag scopes the
+        flush to that tenant's entries (srv/tenancy.py — one tenant's
+        mutation stream must never cold-start another's warm set)."""
+        return self._bump(None, tenant)
 
-    def bump_scoped(self, footprint) -> int:
+    def bump_scoped(self, footprint, tenant: Optional[str] = None) -> int:
         """Scoped epoch bump (ops/delta.Footprint): entries and in-flight
         writers whose request features are disjoint from ``footprint``
         survive; everything else behaves exactly as a global bump.  A
         global or empty-with-global footprint degrades to
-        :meth:`bump_epoch`."""
+        :meth:`bump_epoch` (tenant tag preserved)."""
         if footprint is None or getattr(footprint, "global_", True):
-            return self._bump(None)
-        epoch = self._bump(footprint)
+            return self._bump(None, tenant)
+        epoch = self._bump(footprint, tenant)
         self._count("scoped_bumps")
         return epoch
 
-    def _bump(self, footprint) -> int:
+    def _bump(self, footprint, tenant: Optional[str] = None) -> int:
         with self._stats_lock:
             self._epoch += 1
-            self._bumps.append((self._epoch, footprint))
+            self._bumps.append((self._epoch, footprint, tenant))
             return self._epoch
 
     def flush(self) -> int:
@@ -474,25 +519,49 @@ class DecisionCache:
         self.bump_epoch()
         return dropped
 
-    def evict_subject(self, subject_id: str) -> int:
+    def evict_subject(self, subject_id: str,
+                      tenant: Optional[str] = None) -> int:
         """Drop every entry fingerprinted under ``subject_id``
-        (``userModified``/``userDeleted`` invalidation path)."""
+        (``userModified``/``userDeleted`` invalidation path).  With a
+        ``tenant``, only that tenant's entries for the subject drop; an
+        untenanted eviction walks only default-domain keys — tenanted
+        keys carry the tenant prefix, so cross-tenant eviction is
+        structurally impossible on either path."""
         if not subject_id:
             return 0
-        return self._evict_prefix(subject_id + _SEP)
+        if tenant:
+            return self._evict_prefix(
+                f"{tenant}{_TSEP}{subject_id}{_SEP}"
+            )
+        return self._evict_prefix(subject_id + _SEP,
+                                  default_domain_only=True)
 
-    def evict_pattern(self, pattern: str) -> int:
+    def evict_pattern(self, pattern: str,
+                      tenant: Optional[str] = None) -> int:
         """The reference ``flush_cache`` pattern semantics against the
-        subject-id prefix of the key space; empty pattern flushes all."""
+        subject-id prefix of the key space; empty pattern flushes all.
+        With a ``tenant``, the walk is confined to that tenant's key
+        prefix (empty pattern drops the whole tenant, nothing else)."""
+        if tenant:
+            return self._evict_prefix(f"{tenant}{_TSEP}{pattern}")
         if not pattern:
             return self.flush()
-        return self._evict_prefix(pattern)
+        return self._evict_prefix(pattern, default_domain_only=True)
 
-    def _evict_prefix(self, prefix: str) -> int:
+    def _evict_prefix(self, prefix: str,
+                      default_domain_only: bool = False) -> int:
+        """``default_domain_only`` confines an untenanted prefix walk to
+        untenanted keys: a tenant id that happens to start with the prefix
+        (e.g. pattern "u1" vs tenant "u1-corp") must not get its whole
+        namespace evicted by a default-domain flush."""
         dropped = 0
         for shard in self._shards:
             with shard.lock:
-                stale = [k for k in shard.entries if k.startswith(prefix)]
+                stale = [
+                    k for k in shard.entries
+                    if k.startswith(prefix)
+                    and not (default_domain_only and _TSEP in k)
+                ]
                 for k in stale:
                     del shard.entries[k]
                 dropped += len(stale)

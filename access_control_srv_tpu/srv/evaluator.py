@@ -59,6 +59,9 @@ class HybridEvaluator:
         decision_cache=None,
         delta_enabled: bool = True,
         observability=None,
+        shared_jits: Optional[dict] = None,
+        fixed_caps=None,
+        tenant: Optional[str] = None,
     ):
         self.engine = engine
         self.backend = backend
@@ -126,7 +129,24 @@ class HybridEvaluator:
         )
         self._caps = None                   # delta_mod.Capacities
         self._delta_state = None            # delta_mod.DeltaState
-        self._shared_jits: dict = {}        # jitted executables, swap-stable
+        # jitted executables, swap-stable.  An INJECTED dict (multi-tenant
+        # packing, srv/tenancy.py) is shared by every evaluator in one
+        # size class: identical table shapes -> the per-shape cache inside
+        # each jitted callable hits, so N same-class tenants cost the
+        # class's compile count, not N compiles.
+        self._shared_jits: dict = (
+            shared_jits if shared_jits is not None else {}
+        )
+        # pinned capacity class (delta_mod.Capacities): full compiles go
+        # through fixed_caps_compile so the published shapes never drift
+        # from the class.  On class overflow the compile falls back to
+        # per-tenant buckets (serving never breaks) and the tenancy
+        # registry detects the caps drift and promotes the tenant.
+        self.fixed_caps = fixed_caps
+        # tenant id this evaluator serves (None = the default domain):
+        # scopes decision-cache keys/bumps so one tenant's mutations never
+        # flush another's entries
+        self.tenant = tenant
         self._delta_counts = {
             "patches": 0, "full_compiles": 0, "noops": 0,
             "recompiles_avoided": 0, "fallbacks": 0,
@@ -439,7 +459,28 @@ class HybridEvaluator:
         # trees from this snapshot)
         tree_snapshot = copy.deepcopy(self.engine.policy_sets)
         caps = state = None
-        if self.delta_enabled:
+        if self.delta_enabled and self.fixed_caps is not None:
+            try:
+                compiled, caps, state = delta_mod.fixed_caps_compile(
+                    tree_snapshot, self.engine.urns, self.fixed_caps,
+                    version=version,
+                )
+            except delta_mod.DeltaIneligible as err:
+                # class overflow: serve from per-tenant buckets rather
+                # than fail — the tenancy registry compares the published
+                # caps against the class and promotes the tenant
+                if self.logger:
+                    self.logger.info(
+                        "tenant tree overflows pinned size class; "
+                        "falling back to per-tenant capacity buckets",
+                        extra={"reason": err.reason,
+                               "tenant": self.tenant},
+                    )
+                compiled, caps, state = delta_mod.full_bucketed_compile(
+                    tree_snapshot, self.engine.urns, version=version,
+                    prev_caps=None,
+                )
+        elif self.delta_enabled:
             compiled, caps, state = delta_mod.full_bucketed_compile(
                 tree_snapshot, self.engine.urns, version=version,
                 prev_caps=self._caps,

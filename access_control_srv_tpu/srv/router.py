@@ -118,6 +118,11 @@ class ReplicaHandle:
         # pod fingerprint through program_identity; the router tracks it
         # per replica so cluster_status exposes shard-level convergence
         self.pod_fingerprint = None
+        # multi-tenant replicas (srv/tenancy.py) report a tenancy block
+        # through program_identity; the router tracks tenant count and
+        # the per-tenant epoch digest so cluster_status exposes
+        # tenant-level convergence across replicas
+        self.tenancy = None
         self.last_seen = 0.0
         self.calls = 0
         self.failures = 0
@@ -145,6 +150,7 @@ class ReplicaHandle:
             "inflight": self.inflight,
             "policy_epoch": self.policy_epoch,
             "pod_fingerprint": self.pod_fingerprint,
+            "tenancy": self.tenancy,
             "breaker": self.breaker.state,
             "calls": self.calls,
             "failures": self.failures,
@@ -258,6 +264,9 @@ class ClusterRouter:
             sharding = payload.get("sharding")
             if isinstance(sharding, dict):
                 replica.pod_fingerprint = sharding.get("pod_fingerprint")
+            tenancy = payload.get("tenancy")
+            if isinstance(tenancy, dict):
+                replica.tenancy = tenancy
             replica.last_seen = time.monotonic()
             replica.healthy = True
         except Exception:  # noqa: BLE001 — an unreachable replica
@@ -595,8 +604,16 @@ class ClusterRouter:
             r["pod_fingerprint"] for r in replicas
             if r.get("pod_fingerprint") is not None
         }
+        tenancy_blocks = [
+            r["tenancy"] for r in replicas
+            if isinstance(r.get("tenancy"), dict)
+        ]
+        tenant_digests = {
+            b.get("epoch_digest") for b in tenancy_blocks
+            if b.get("epoch_digest") is not None
+        }
         snap = self.overhead.snapshot()
-        return {
+        out = {
             "addr": self.addr,
             "replicas": replicas,
             "converged": len(set(epochs)) <= 1,
@@ -615,6 +632,18 @@ class ClusterRouter:
                 if snap["p99_s"] is not None else None,
             },
         }
+        if tenancy_blocks:
+            # tenant-level convergence: every replica reporting a tenancy
+            # block holds identical per-tenant epochs (blake2b digest over
+            # the sorted tenant->epoch map, srv/tenancy.py epoch_digest)
+            out["tenancy"] = {
+                "replicas_reporting": len(tenancy_blocks),
+                "tenant_count": max(
+                    (b.get("tenant_count") or 0) for b in tenancy_blocks
+                ),
+                "tenant_converged": len(tenant_digests) <= 1,
+            }
+        return out
 
 
 class _ProxyHandler(grpc.GenericRpcHandler):

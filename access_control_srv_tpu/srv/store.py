@@ -701,6 +701,13 @@ class PolicyReplicator:
         self.bus = bus
         self.logger = logger
         self.debounce_s = debounce_s
+        # multi-tenant registry (srv/tenancy.TenantRegistry), wired by
+        # the worker: CRUD frames whose envelope carries a ``tenant`` key
+        # belong to a tenant domain, not the global tree — they are routed
+        # to the registry (boot replay included, so a new tenant boots by
+        # replay) and never enter the global debounced sync.  None drops
+        # tenant-tagged frames (single-tenant deployment).
+        self.tenancy = None
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None  # guarded-by: _lock
         self._stopped = False  # guarded-by: _lock
@@ -774,6 +781,29 @@ class PolicyReplicator:
             if offset >= 0:
                 self._mark_applied(topic, offset)
             return  # our own mutation, already applied + synced
+        tenant = message.get("tenant")
+        if tenant is not None:
+            # tenant-scoped frame: apply to the tenant registry (which
+            # recomposes/patches only that tenant's domain); the global
+            # tree is untouched, so no debounced sync is scheduled.  The
+            # watermark still advances — tenant frames count toward the
+            # replica's journal-replay epoch.
+            registry = self.tenancy
+            if registry is not None:
+                try:
+                    registry.apply_remote_frame(
+                        str(tenant), kind, event_name,
+                        message.get("payload"),
+                    )
+                except Exception:  # noqa: BLE001 — bad frame, not the pump
+                    if self.logger:
+                        self.logger.exception(
+                            "tenant replication apply failed",
+                            extra={"topic": topic, "tenant": tenant},
+                        )
+            if offset >= 0:
+                self._mark_applied(topic, offset)
+            return
         doc = message.get("payload")
         if not isinstance(doc, dict):
             if offset >= 0:

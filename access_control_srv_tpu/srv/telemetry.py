@@ -257,6 +257,71 @@ class ValueHistogram:
         return out
 
 
+class TenantCounter:
+    """Bounded-cardinality per-tenant event counter (srv/tenancy.py).
+
+    Tenant ids arrive from request metadata — attacker-controlled — so a
+    naive ``{tenant: count}`` map is an unbounded-cardinality attack on
+    the metrics registry (10k distinct ids = 10k Prometheus series).
+    Exact counts are kept for at most ``max_tracked`` distinct ids;
+    events from ids beyond the bound aggregate under ``__other__``.
+    Slots are first-come and never evicted: recycling a slot would make
+    an exposed counter non-monotonic, which Prometheus ``rate()``
+    misreads as a reset.  ``snapshot`` ranks tenants by traffic so the
+    top-K stay visible regardless of arrival order."""
+
+    OTHER = "__other__"
+
+    def __init__(self, max_tracked: int = 64):
+        self.max_tracked = int(max_tracked)
+        self._tenants: set[str] = set()  # guarded-by: _lock
+        # (event, tenant) -> count; at most max_tracked+1 tenant values
+        self._values: dict[tuple, int] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def inc(self, kind: str, tenant: str, by: int = 1) -> None:
+        kind, tenant = str(kind), str(tenant)
+        with self._lock:
+            if tenant != self.OTHER and tenant not in self._tenants:
+                if len(self._tenants) >= self.max_tracked:
+                    tenant = self.OTHER
+                else:
+                    self._tenants.add(tenant)
+            key = (kind, tenant)
+            self._values[key] = self._values.get(key, 0) + by
+
+    def tracked(self) -> int:
+        with self._lock:
+            return len(self._tenants)
+
+    def prom_snapshot(self) -> dict:
+        """{(event, tenant): count} — the full tracked (bounded) set,
+        for the Prometheus exposition."""
+        with self._lock:
+            return dict(self._values)
+
+    def snapshot(self, top_k: int = 16) -> dict:
+        """{event: {tenant: count}} with at most ``top_k`` tenants per
+        event by traffic; trimmed tenants fold into ``__other__`` so the
+        per-event totals stay exact."""
+        with self._lock:
+            items = dict(self._values)
+        grouped: dict[str, dict[str, int]] = {}
+        for (kind, tenant), count in items.items():
+            grouped.setdefault(kind, {})[tenant] = count
+        out: dict[str, dict[str, int]] = {}
+        for kind, per_tenant in grouped.items():
+            other = per_tenant.pop(self.OTHER, 0)
+            ranked = sorted(per_tenant.items(),
+                            key=lambda kv: (-kv[1], kv[0]))
+            trimmed = dict(ranked[:top_k])
+            other += sum(count for _, count in ranked[top_k:])
+            if other:
+                trimmed[self.OTHER] = other
+            out[kind] = trimmed
+        return out
+
+
 class Counter:
     def __init__(self):
         self._values: dict[str, int] = {}  # guarded-by: _lock
@@ -367,6 +432,14 @@ class MetricsRegistry:
                 label: str = "key") -> None:
         self._entries.append(("counter", name, help_text, (counter, label)))
 
+    def multi_counter(self, name: str, help_text: str,
+                      snapshot_fn: Callable[[], dict],
+                      labels: tuple) -> None:
+        """Counter family with several labels: ``snapshot_fn`` returns
+        ``{(value_per_label, ...): count}`` at render time."""
+        self._entries.append(("multi_counter", name, help_text,
+                              (snapshot_fn, labels)))
+
     def histogram(self, name: str, help_text: str, histogram) -> None:
         self._entries.append(("histogram", name, help_text,
                               (lambda: {None: histogram}, None)))
@@ -420,6 +493,19 @@ class MetricsRegistry:
                         f'{name}{{{label}="{_prom_escape(key)}"}} '
                         f"{values[key]}"
                     )
+            elif kind == "multi_counter":
+                snapshot_fn, labels = payload
+                values = snapshot_fn()
+                if not values:
+                    continue
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                for key in sorted(values):
+                    pairs = ",".join(
+                        f'{lbl}="{_prom_escape(val)}"'
+                        for lbl, val in zip(labels, key)
+                    )
+                    lines.append(f"{name}{{{pairs}}} {values[key]}")
             elif kind == "histogram":
                 group_fn, label = payload
                 group = group_fn()
@@ -525,6 +611,10 @@ class Telemetry:
         # counts, fed by the registry's on_hit hook — operators see
         # exactly which failpoints fired and how often
         self.failpoints = Counter()
+        # per-tenant serving events (srv/tenancy.py): decision / shed /
+        # cache_hit / cache_miss per tenant id, cardinality-bounded —
+        # see TenantCounter
+        self.tenants = TenantCounter()
         # device-hang watchdog (srv/watchdog.py): attached by the worker
         # when enabled; the degraded/quarantine gauges read 0 without one
         self._watchdog = None
@@ -578,6 +668,12 @@ class Telemetry:
         reg.histogram("acs_admission_budget_seconds",
                       "Remaining deadline budget at admit",
                       self.admission_budget)
+        reg.multi_counter(
+            "acs_tenant_events_total",
+            "Per-tenant serving events (decision/shed/cache_hit/...; "
+            "cardinality-bounded, overflow under __other__)",
+            self.tenants.prom_snapshot, labels=("event", "tenant"),
+        )
         reg.counter("acs_failpoint_hits_total",
                     "Deterministic fault-injection hits per site "
                     "(srv/faults.py)", self.failpoints, label="site")
@@ -638,6 +734,12 @@ class Telemetry:
         finally:
             histogram.observe(time.perf_counter() - t0)
 
+    def tenant_inc(self, kind: str, tenant: str, by: int = 1) -> None:
+        """Per-tenant counter hook (admission sheds, tenant decisions,
+        cache events); safe at any cardinality — overflow ids aggregate
+        under ``__other__``."""
+        self.tenants.inc(kind, tenant, by)
+
     def record_decision(self, decision: str) -> None:
         self.decisions.inc(decision)
 
@@ -653,6 +755,7 @@ class Telemetry:
 
         failpoint_hits = self.failpoints.snapshot()
         faults_enabled = _faults_registry.enabled
+        tenant_events = self.tenants.snapshot()
         # assembled under the snapshot lock and returned as a DEEP copy:
         # concurrent `metrics`/`health_check` readers serialize their own
         # private tree — they can never observe a dict mutating under a
@@ -689,6 +792,10 @@ class Telemetry:
             # fault-injection / device-health blocks only appear when the
             # subsystems are live — snapshots of an untouched worker stay
             # byte-identical to the pre-failpoint shape
+            # per-tenant events only appear once a tenant-tagged request
+            # was served — untenanted workers keep the exact legacy shape
+            if tenant_events:
+                out["tenants"] = tenant_events
             if faults_enabled or failpoint_hits:
                 out["failpoints"] = {
                     "enabled": faults_enabled,

@@ -28,7 +28,7 @@ from ..models.model import (
     Target,
 )
 from ..ops.compile import DECISION_NAMES
-from .admission import deadline_from_context
+from .admission import deadline_from_context, tenant_from_metadata
 from .gen import access_control_pb2 as pb
 from .tracing import (
     STAGE_DECODE,
@@ -509,10 +509,13 @@ class GrpcServer:
             # deadline (or x-acs-timeout-ms metadata) becomes the
             # request's budget — rejected at submit when infeasible,
             # dropped at dispatch when expired
+            tenant = tenant_from_metadata(context)
             if obs is None or obs.tracer is None:
+                req = request_from_pb(request)
+                if tenant is not None:
+                    req._tenant = tenant
                 response = worker.service.is_allowed(
-                    request_from_pb(request),
-                    deadline=deadline_from_context(context),
+                    req, deadline=deadline_from_context(context),
                 )
                 stamp_trailers(
                     context, worker,
@@ -527,6 +530,8 @@ class GrpcServer:
             t0 = time.perf_counter()
             span = tracer.start_span(trace_id_from_metadata(context))
             req = request_from_pb(request)
+            if tenant is not None:
+                req._tenant = tenant
             tracer.record(span, STAGE_TRANSPORT_PARSE,
                           time.perf_counter() - t0)
             req._sampling_done = True
@@ -557,6 +562,7 @@ class GrpcServer:
 
             t0 = _time.perf_counter()
             deadline = deadline_from_context(context)
+            tenant = tenant_from_metadata(context)
             tracer = obs.tracer if obs is not None else None
             span = None
             t_stage = t0
@@ -588,6 +594,12 @@ class GrpcServer:
                 return payload
 
             evaluator = worker.service.evaluator
+            # tenanted batches must resolve against the tenant's own
+            # tables (srv/tenancy.py) — the native wire fast path binds
+            # the default-domain program, so route through the service
+            # path where the batcher partitions by tenant
+            if tenant is not None:
+                messages = None
             if messages is not None and evaluator is not None:
                 out = None
                 try:
@@ -636,6 +648,9 @@ class GrpcServer:
                 t_stage = _time.perf_counter()
             request = pb.BatchRequest.FromString(raw)
             reqs = [request_from_pb(r) for r in request.requests]
+            if tenant is not None:
+                for req in reqs:
+                    req._tenant = tenant
             if tracer is not None:
                 now = _time.perf_counter()
                 tracer.record(span, STAGE_TRANSPORT_PARSE, now - t_stage)
@@ -717,16 +732,23 @@ class GrpcServer:
             stamp_trailers(context, worker)
 
         def what_is_allowed(request, context):
+            req = request_from_pb(request)
+            tenant = tenant_from_metadata(context)
+            if tenant is not None:
+                req._tenant = tenant
             rq = worker.service.what_is_allowed(
-                request_from_pb(request),
-                deadline=deadline_from_context(context),
+                req, deadline=deadline_from_context(context),
             )
             return reverse_query_to_pb(rq)
 
         def what_is_allowed_batch(request, context):
+            reqs = [request_from_pb(m) for m in request.requests]
+            tenant = tenant_from_metadata(context)
+            if tenant is not None:
+                for req in reqs:
+                    req._tenant = tenant
             rqs = worker.service.what_is_allowed_batch(
-                [request_from_pb(m) for m in request.requests],
-                deadline=deadline_from_context(context),
+                reqs, deadline=deadline_from_context(context),
             )
             return pb.BatchReverseQuery(
                 responses=[reverse_query_to_pb(rq) for rq in rqs]

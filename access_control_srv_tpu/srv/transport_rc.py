@@ -37,7 +37,7 @@ import time
 import grpc
 
 from ..models.model import Attribute, Request, Target
-from .admission import deadline_from_context
+from .admission import deadline_from_context, tenant_from_metadata
 from .tracing import (
     STAGE_SERIALIZE,
     STAGE_TRANSPORT_PARSE,
@@ -410,11 +410,14 @@ def register_rc_services(server, worker) -> None:
         # rc-wire deadline propagation: native gRPC deadlines and the
         # x-acs-timeout-ms metadata key both become the request budget
         # (srv/admission.deadline_from_context)
+        tenant = tenant_from_metadata(context)
         if obs is None or obs.tracer is None:
+            req = request_from_rc(request)
+            if tenant is not None:
+                req._tenant = tenant
             return response_to_rc(
                 worker.service.is_allowed(
-                    request_from_rc(request),
-                    deadline=deadline_from_context(context),
+                    req, deadline=deadline_from_context(context),
                 )
             )
         # traced path: same span/trace-id contract as the acstpu-wire
@@ -424,6 +427,8 @@ def register_rc_services(server, worker) -> None:
         t0 = time.perf_counter()
         span = tracer.start_span(trace_id_from_metadata(context))
         req = request_from_rc(request)
+        if tenant is not None:
+            req._tenant = tenant
         tracer.record(span, STAGE_TRANSPORT_PARSE,
                       time.perf_counter() - t0)
         req._sampling_done = True
@@ -442,10 +447,13 @@ def register_rc_services(server, worker) -> None:
         return msg
 
     def what_is_allowed(request, context):
+        req = request_from_rc(request)
+        tenant = tenant_from_metadata(context)
+        if tenant is not None:
+            req._tenant = tenant
         return reverse_query_to_rc(
             worker.service.what_is_allowed(
-                request_from_rc(request),
-                deadline=deadline_from_context(context),
+                req, deadline=deadline_from_context(context),
             )
         )
 

@@ -63,6 +63,7 @@ class Worker:
         self.mesh = None
         self.obs = None  # srv/tracing.Observability (None = disabled)
         self.replicator = None
+        self.tenancy = None  # srv/tenancy.TenantRegistry (None = off)
         self.watchdog = None  # srv/watchdog.DeviceWatchdog (None = off)
         self._faults_armed = False
         # live CRUD-offset watermark per topic (policy_epoch fallback for
@@ -397,6 +398,21 @@ class Worker:
             logger=self.logger,
         )
 
+        # multi-tenant registry (srv/tenancy.py): tenant-tagged traffic
+        # resolves against per-tenant tables on class-shared compiled
+        # programs; None (tenancy:enabled false, the default) keeps every
+        # path byte-identical to single-tenant behavior
+        from . import tenancy as tenancy_mod
+
+        self.tenancy = tenancy_mod.from_config(
+            cfg, self.engine.urns,
+            logger=self.logger,
+            telemetry=self.telemetry,
+            decision_cache=self.decision_cache,
+            store=self.store,
+            observability=self.obs,
+        )
+
         # service facade + command interface + micro-batcher
         self.service = AccessControlService(
             cfg, self.engine, self.evaluator, self.store, self.logger,
@@ -424,6 +440,7 @@ class Worker:
             # feasibility estimate reads the same config value
             pipeline_depth=cfg.get("evaluator:pipeline_depth", 2),
         )
+        self.batcher.tenancy = self.tenancy
         self.batcher.start()
         self.service.batcher = self.batcher
 
@@ -471,7 +488,11 @@ class Worker:
 
             self.replicator = PolicyReplicator(
                 self.store, self.bus, logger=self.logger
-            ).start()
+            )
+            # tenant-tagged journal frames route to the registry (boot
+            # replay onboards every journaled tenant before serving)
+            self.replicator.tenancy = self.tenancy
+            self.replicator.start()
             # boot-time catch-up gate: don't return (and so don't let the
             # CLI open the serving port) until the journal tail observed
             # at boot is reflected in the tree — a half-replayed replica
@@ -505,6 +526,8 @@ class Worker:
             # join the debounced async-compile worker instead of leaking a
             # daemon thread mid-XLA-compile (srv/evaluator.shutdown)
             self.evaluator.shutdown()
+        if getattr(self, "tenancy", None) is not None:
+            self.tenancy.shutdown()
         if getattr(self, "replicator", None) is not None:
             self.replicator.stop()
         if getattr(self, "store", None) is not None:
@@ -560,6 +583,12 @@ class Worker:
             and message.get("origin") == self.store.origin
         ):
             return
+        if isinstance(message, dict) and message.get("tenant") is not None:
+            # tenant-scoped frame: the replicator routes it to the tenant
+            # registry, which bumps ONLY that tenant's cache namespace —
+            # a global bump here would flush every other tenant's entries
+            # on one tenant's CRUD (isolation + perf)
+            return
         if self.decision_cache is not None:
             self.decision_cache.bump_epoch()
 
@@ -579,13 +608,21 @@ class Worker:
 
     def _user_listener(self, event_name: str, message, ctx: dict) -> None:
         """userModified / userDeleted -> subject-cache + decision-cache
-        eviction (reference: src/worker.ts:300-345)."""
+        eviction (reference: src/worker.ts:300-345).  A ``tenant`` key on
+        the event scopes the decision-cache eviction to the originating
+        tenant's namespace: one tenant's user churn must not evict
+        another tenant's cached decisions (isolation + perf)."""
+        tenant = (message or {}).get("tenant") if isinstance(
+            message, dict
+        ) else None
         if event_name == "userDeleted":
             user_id = (message or {}).get("id")
             if user_id:
                 self.hr_provider.evict_hr_scopes(user_id)
                 if self.decision_cache is not None:
-                    self.decision_cache.evict_subject(user_id)
+                    self.decision_cache.evict_subject(
+                        user_id, tenant=tenant
+                    )
                 # the event carries no token list; the resolution cache
                 # indexes entries by payload subject id for exactly this
                 if hasattr(self.identity_client, "evict_subject"):
@@ -599,7 +636,7 @@ class Worker:
             # prefix eviction also clears entries for the OLD associations
             # (reference analog: utils.ts flushACSCache on user mutation)
             if self.decision_cache is not None:
-                self.decision_cache.evict_subject(user_id)
+                self.decision_cache.evict_subject(user_id, tenant=tenant)
             # token resolutions for a mutated user are stale regardless of
             # role-association diffing
             if hasattr(self.identity_client, "evict"):
@@ -621,14 +658,14 @@ class Worker:
             )
             if changed:
                 self.hr_provider.evict_hr_scopes(user_id)
+                data = {"db_index": 5, "pattern": user_id}
+                if tenant is not None:
+                    # scope the fleet-wide flush to the originating
+                    # tenant's cache namespace
+                    data["tenant"] = tenant
                 self.bus.topic("io.restorecommerce.command").emit(
                     "flushCacheCommand",
-                    {
-                        "name": "flush_cache",
-                        "payload": {
-                            "data": {"db_index": 5, "pattern": user_id}
-                        },
-                    },
+                    {"name": "flush_cache", "payload": {"data": data}},
                 )
 
     # ------------------------------------------------- CRUD self-authorization

@@ -1019,6 +1019,131 @@ def main() -> int:
                  "(docs/SHARDING.md)"),
     })
 
+    # 11. tenant-packing program identity (srv/tenancy.py,
+    # docs/MULTITENANT.md): 1k synthetic tenants spread over the size
+    # classes must serve from at most len(SIZE_CLASSES) compiled
+    # programs — tenants in one class pad to identical shapes, so the
+    # shared jit table lowers ONE program per class+variant and every
+    # other tenant's tables enter as arguments.  And one tenant's CRUD
+    # must delta-patch only that tenant's tables with ZERO new XLA
+    # compiles and no decision drift on any other tenant.
+    from access_control_srv_tpu.srv.tenancy import (
+        SIZE_CLASSES,
+        TenantRegistry,
+    )
+
+    urns_t = Urns()
+
+    def _t_entity(k):
+        return f"urn:restorecommerce:acs:model:tthing{k}.TThing{k}"
+
+    def _t_rule(rid, k, effect="PERMIT"):
+        return {"id": rid, "target": {
+            "subjects": [{"id": urns_t["role"], "value": f"role-{k % 3}"}],
+            "resources": [{"id": urns_t["entity"], "value": _t_entity(k % 4)}],
+            "actions": [{"id": urns_t["actionID"], "value": urns_t["read"]}]},
+            "effect": effect, "evaluation_cacheable": True}
+
+    def _t_request(k):
+        role = f"role-{k % 3}"
+        return Request(
+            target=Target(
+                subjects=[Attribute(id=urns_t["role"], value=role),
+                          Attribute(id=urns_t["subjectID"], value=f"u{k}")],
+                resources=[Attribute(id=urns_t["entity"],
+                                     value=_t_entity(k % 4))],
+                actions=[Attribute(id=urns_t["actionID"],
+                                   value=urns_t["read"])],
+            ),
+            context={"resources": [], "subject": {
+                "id": f"u{k}",
+                "role_associations": [{"role": role, "attributes": []}],
+                "hierarchical_scopes": [],
+            }},
+        )
+
+    # rule counts picked to land one tenant in each size class
+    _rules_per_class = (2, 6, 12, 24)
+    registry_t = TenantRegistry(urns_t)
+    n_tenants = 1000
+    for i in range(n_tenants):
+        tid = f"tenant-{i:04d}"
+        n_rules = _rules_per_class[i % len(_rules_per_class)]
+        for j in range(n_rules):
+            registry_t.apply(tid, "rule", "upsert", _t_rule(f"r{j}", j),
+                             emit=False)
+        registry_t.apply(tid, "policy", "upsert",
+                         {"id": "p0", "combining_algorithm": PO5,
+                          "rules": [f"r{j}" for j in range(n_rules)]},
+                         emit=False)
+        registry_t.apply(tid, "policy_set", "upsert",
+                         {"id": "ps0", "combining_algorithm": PO5,
+                          "policies": ["p0"]}, emit=False)
+    t_reqs = [_t_request(k) for k in range(8)]
+    for i in range(n_tenants):
+        registry_t.evaluator_for(f"tenant-{i:04d}").is_allowed_batch(t_reqs)
+    classes_t = registry_t.class_histogram()
+    programs_t = registry_t.compiled_program_count()
+    packing_ok = (
+        len(classes_t) <= len(SIZE_CLASSES)
+        and "__unpinned__" not in classes_t
+        and programs_t <= len(SIZE_CLASSES)
+    )
+    # single-tenant CRUD: patch tenant-0002's referenced rule; only its
+    # fingerprint moves, jit shape caches are untouched, and a sibling
+    # tenant in the same class serves byte-identical decisions
+    sibling_before = [
+        r.decision
+        for r in registry_t.evaluator_for("tenant-0006").is_allowed_batch(
+            t_reqs)
+    ]
+    fp_before_t = registry_t.fingerprints()
+    jit_before_t = {
+        repr(k): f._cache_size()
+        for k, f in registry_t._shared_jits.items()
+    }
+    registry_t.apply("tenant-0002", "rule", "upsert",
+                     _t_rule("r0", 0, effect="DENY"), emit=False)
+    fp_after_t = registry_t.fingerprints()
+    jit_after_t = {
+        repr(k): f._cache_size()
+        for k, f in registry_t._shared_jits.items()
+    }
+    changed_t = sorted(
+        t for t in fp_before_t if fp_before_t[t] != fp_after_t.get(t)
+    )
+    patched_stats = registry_t.evaluator_for("tenant-0002").delta_stats()
+    sibling_after = [
+        r.decision
+        for r in registry_t.evaluator_for("tenant-0006").is_allowed_batch(
+            t_reqs)
+    ]
+    patch_scoped_ok = (
+        changed_t == ["tenant-0002"]
+        and jit_after_t == jit_before_t
+        and patched_stats["patches"] >= 1
+        and sibling_after == sibling_before
+    )
+    registry_t.shutdown()
+    results.append({
+        "kernel": "tenant-packing-program-identity",
+        "ok": bool(packing_ok and patch_scoped_ok),
+        "tenants": n_tenants,
+        "size_classes": classes_t,
+        "compiled_programs": programs_t,
+        "program_bound": len(SIZE_CLASSES),
+        "patch_changed_fingerprints": changed_t,
+        "patch_zero_new_xla_compiles": bool(jit_after_t == jit_before_t),
+        "patch_delta_patches": patched_stats["patches"],
+        "sibling_decisions_stable": bool(sibling_after == sibling_before),
+        "note": ("1k tenants bucketed onto the fixed capacity ladder "
+                 "serve from at most one compiled program per size class "
+                 "(per-tenant tables are jit arguments, srv/tenancy.py); "
+                 "one tenant's CRUD delta-patches only that tenant's "
+                 "tables with zero new XLA compiles and no decision "
+                 "drift on same-class siblings (docs/MULTITENANT.md)"),
+    })
+
     # ---- static-invariants-clean: acs-lint gate over the shipped tree.
     # The audit's host-only rows (tracing/admission-zero-device-ops)
     # prove specific modules import no device runtime; this row proves

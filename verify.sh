@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Full local verification gate: tier-1 tests, the acs-lint static gate,
+# and the TPU-compat audit, in that order, stopping at the first failure.
+# `make verify` runs this; CI and pre-commit should too.
+#
+# Environment:
+#   JAX_PLATFORMS   defaults to cpu (the audit and tests are
+#                   platform-differential; a live chip just makes them
+#                   slower to compile, not more correct)
+#   VERIFY_SKIP_AUDIT=1  skip the audit step (it rebuilds 1k tenant
+#                   domains and a 20k-rule tree; tier-1 + lint alone
+#                   take ~2 min, the audit adds a few more)
+set -o pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== [1/3] tier-1 tests (pytest -m 'not slow') =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "verify: FAILED at tier-1 tests (rc=$rc)" >&2
+    exit "$rc"
+fi
+
+echo "== [2/3] acs-lint (zero new findings vs baseline) =="
+if ! python -m access_control_srv_tpu.analysis; then
+    echo "verify: FAILED at acs-lint" >&2
+    exit 1
+fi
+
+if [ "${VERIFY_SKIP_AUDIT:-0}" = "1" ]; then
+    echo "== [3/3] tpu_compat_audit: SKIPPED (VERIFY_SKIP_AUDIT=1) =="
+else
+    echo "== [3/3] tpu_compat_audit =="
+    if ! BENCH_PLATFORM="${BENCH_PLATFORM:-cpu}" python tpu_compat_audit.py; then
+        echo "verify: FAILED at tpu_compat_audit" >&2
+        exit 1
+    fi
+fi
+
+echo "verify: OK"
