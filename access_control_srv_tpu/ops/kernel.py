@@ -768,14 +768,23 @@ def _policy_gates(c: dict, r: dict, m: dict):
 
 def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
                              cond_a, cond_c, pol_gate, set_gate,
-                             pol_subject=None):
+                             pol_subject=None, explain: bool = False):
     """Flat-rule-axis variant of _combine_and_decide for the signature
     kernel: inputs arrive as [S, KP*KR] planes and the per-policy KR
     reductions run as reduce_windows, so batched callers avoid
     [B, S, KP, KR] intermediates whose tiny trailing dim pads to the
     TPU's 128-lane tile (8x memory at KR=16).  Flat positions preserve
     the original (set, policy, rule) ordering, so first/last semantics
-    and the abort's flat-order selection are unchanged."""
+    and the abort's flat-order selection are unchanged.
+
+    ``explain=True`` appends a 4th int32 output encoding the deciding
+    node: ``(flat_pos << 2) | kind`` with kind 0 = no contribution,
+    1 = rule at flat pos (s*KP + kp)*KR + kr, 2 = no-rules policy at
+    pos s*KP + kp, 3 = condition abort at the rule's flat pos.  When the
+    caller compacted the rule axis (ops/prefilter.compact_rules) it
+    supplies ``c["rule_orig_flat"]`` mapping compacted slots back to
+    original flat positions; the flag is Python-level, so the False
+    trace is exactly the pre-explain computation."""
     S, KP, KR = c["rule_effect"].shape
     M = KP * KR
     re_f = c["rule_effect"].reshape(S, M)
@@ -832,9 +841,14 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
     contrib_cach = jnp.where(
         no_rules_contrib, c["pol_cacheable"], rule_cach_sel
     )
-    decision, cacheable = _combine_sets(
-        c, contrib_present, contrib_eff, contrib_cach
-    )
+    if explain:
+        decision, cacheable, win_s, have, s_sel_c = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach, explain=True
+        )
+    else:
+        decision, cacheable = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach
+        )
     status = jnp.int32(200)
 
     # condition aborts preempt everything, first in flat rule order
@@ -850,15 +864,51 @@ def _combine_and_decide_flat(c: dict, reached, acl_rule, has_cond, cond_t,
     decision = jnp.where(has_abort, 2, decision)
     cacheable = jnp.where(has_abort, abort_cach, cacheable)
     status = jnp.where(has_abort, abort_code, status)
-    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+    if not explain:
+        return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
 
-
-def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
-    """Stages F-G (pre-abort): policy-effect combination per set and the
-    last-set-wins decision; shared by both kernels."""
-    set_eff, set_cach, set_any = _per_set_effects(
-        c, contrib_present, contrib_eff, contrib_cach
+    # ------------------------------------------------- explain recovery
+    # the winner's packed key already carries its flat position in the
+    # high bits (pack_rule_key); re-derive (set, policy, rule) from the
+    # same selections the decision used, so provenance is the decision's
+    # by construction
+    win_kp = jnp.take(s_sel_c, win_s)
+    win_flat = win_s * KP + win_kp
+    win_m = jnp.take(sel.reshape(-1), win_flat) >> 3
+    no_rules_win = jnp.take(no_rules_contrib.reshape(-1), win_flat)
+    orig = c.get("rule_orig_flat")
+    if orig is None:
+        rule_pos = win_s * M + win_m
+        abort_orig = abort_flat
+    else:
+        orig_f = orig.reshape(-1)
+        rule_pos = jnp.take(orig_f, jnp.clip(win_s * M + win_m, 0, S * M - 1))
+        abort_orig = jnp.take(orig_f, abort_flat)
+    expl = jnp.where(
+        have,
+        jnp.where(no_rules_win, (win_flat << 2) | 2, (rule_pos << 2) | 1),
+        0,
     )
+    expl = jnp.where(has_abort, (abort_orig << 2) | 3, expl)
+    return (decision.astype(jnp.int32), cacheable, status.astype(jnp.int32),
+            expl.astype(jnp.int32))
+
+
+def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach,
+                  explain: bool = False):
+    """Stages F-G (pre-abort): policy-effect combination per set and the
+    last-set-wins decision; shared by both kernels.  ``explain=True``
+    additionally returns the winning set slot, whether any set
+    contributed, and the per-set selected policy slot — the coordinates
+    explain recovery re-derives provenance from."""
+    if explain:
+        set_eff, set_cach, set_any, s_sel_c = _per_set_effects(
+            c, contrib_present, contrib_eff, contrib_cach, explain=True
+        )
+    else:
+        set_eff, set_cach, set_any = _per_set_effects(
+            c, contrib_present, contrib_eff, contrib_cach
+        )
 
     # last-set-wins (reference: :293-295); effect present but neither
     # PERMIT nor DENY folds to INDETERMINATE with the winning cacheable
@@ -872,10 +922,13 @@ def _combine_sets(c: dict, contrib_present, contrib_eff, contrib_cach):
     cacheable = jnp.where(
         have, jnp.take(set_cach, winner_c).astype(jnp.int32), -1
     )
+    if explain:
+        return decision, cacheable, winner_c, have, s_sel_c
     return decision, cacheable
 
 
-def _per_set_effects(c: dict, contrib_present, contrib_eff, contrib_cach):
+def _per_set_effects(c: dict, contrib_present, contrib_eff, contrib_cach,
+                     explain: bool = False):
     """Stage F alone: combine each set's policy contributions under its
     combining algorithm, returning per-set ``(set_eff, set_cach, set_any)``
     WITHOUT the last-set-wins tail.  Split out so the pod-sharded kernel
@@ -904,11 +957,13 @@ def _per_set_effects(c: dict, contrib_present, contrib_eff, contrib_cach):
     s_sel_c = jnp.clip(s_sel, 0, KP - 1)
     set_eff = jnp.take_along_axis(contrib_eff, s_sel_c[:, None], axis=1)[:, 0]
     set_cach = jnp.take_along_axis(contrib_cach, s_sel_c[:, None], axis=1)[:, 0]
+    if explain:
+        return set_eff, set_cach, set_any, s_sel_c
     return set_eff, set_cach, set_any
 
 
 def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
-                  with_hr: bool = True):
+                  with_hr: bool = True, explain: bool = False):
     """Decision for a single encoded request; vmapped over the batch.
 
     ``c``: compiled policy arrays (replicated across devices).
@@ -918,13 +973,16 @@ def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
     ``with_hr``: compile stage B (exact when some target row carries both
     subjects and a scoping entity; see _match_targets).
     Returns (decision, cacheable, status_code) int32 scalars where
-    decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool.
+    decision: 0=INDETERMINATE 1=PERMIT 2=DENY; cacheable: -1 none 0/1 bool;
+    ``explain=True`` appends the packed provenance code (see
+    _combine_and_decide).
     """
     m = _match_targets(c, r, with_hr)
-    return _evaluate_from_matches(c, r, m, with_acl)
+    return _evaluate_from_matches(c, r, m, with_acl, explain=explain)
 
 
-def _evaluate_from_matches(c: dict, r: dict, m: dict, with_acl: bool = True):
+def _evaluate_from_matches(c: dict, r: dict, m: dict, with_acl: bool = True,
+                           explain: bool = False):
     """Stages C-G given the stage-A/B match vectors ``m``: rule
     reachability, policy/set gates, combining, aborts.  Shared by the full
     kernel (m from _match_targets) and the signature-bit kernel (m rebuilt
@@ -935,16 +993,19 @@ def _evaluate_from_matches(c: dict, r: dict, m: dict, with_acl: bool = True):
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
     return _combine_and_decide(
         c, reached, acl_rule, has_cond, cond_t, cond_a, cond_c,
-        pol_gate, set_gate, pol_subject,
+        pol_gate, set_gate, pol_subject, explain=explain,
     )
 
 
 def _policy_contributions(c: dict, reached, acl_rule, has_cond, cond_t,
-                          cond_a, pol_gate, set_gate, pol_subject):
+                          cond_a, pol_gate, set_gate, pol_subject,
+                          explain: bool = False):
     """Stage E alone: per-policy winning-rule contributions plus the
     abort-rule mask.  Split out of _combine_and_decide so the pod-sharded
     kernel (parallel/pod_shard.py) can run stages A-F shard-locally —
-    whole sets live on one shard — before its cross-shard collectives."""
+    whole sets live on one shard — before its cross-shard collectives.
+    ``explain=True`` additionally returns the per-policy selected rule
+    slot and the no-rules-contribution mask for provenance recovery."""
     scope = set_gate[:, None, None] & pol_gate[:, :, None]
     abort_rule = reached & has_cond & cond_a & scope
     matches = reached & (~has_cond | cond_t) & ~(has_cond & cond_a) & acl_rule
@@ -987,26 +1048,44 @@ def _policy_contributions(c: dict, reached, acl_rule, has_cond, cond_t,
     contrib_present = no_rules_contrib | any_coll
     contrib_eff = jnp.where(no_rules_contrib, c["pol_effect"], rule_eff_sel)
     contrib_cach = jnp.where(no_rules_contrib, c["pol_cacheable"], rule_cach_sel)
+    if explain:
+        return (contrib_present, contrib_eff, contrib_cach, abort_rule,
+                sel_c, no_rules_contrib)
     return contrib_present, contrib_eff, contrib_cach, abort_rule
 
 
 def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
-                        cond_a, cond_c, pol_gate, set_gate, pol_subject):
+                        cond_a, cond_c, pol_gate, set_gate, pol_subject,
+                        explain: bool = False):
     """Stages E-G: rule-effect combination per policy, policy-effect
     combination per set, last-set-wins decision and condition aborts —
-    shared tail of every kernel variant."""
+    shared tail of every kernel variant.  ``explain=True`` appends a 4th
+    int32 output ``(flat_pos << 2) | kind`` (see _combine_and_decide_flat)
+    recovered from the same positional selections the decision used."""
     # -------------------------------------------------- E: combine rule effects
-    contrib_present, contrib_eff, contrib_cach, abort_rule = (
-        _policy_contributions(
+    if explain:
+        (contrib_present, contrib_eff, contrib_cach, abort_rule,
+         sel_c, no_rules_contrib) = _policy_contributions(
             c, reached, acl_rule, has_cond, cond_t, cond_a,
-            pol_gate, set_gate, pol_subject,
+            pol_gate, set_gate, pol_subject, explain=True,
         )
-    )
+    else:
+        contrib_present, contrib_eff, contrib_cach, abort_rule = (
+            _policy_contributions(
+                c, reached, acl_rule, has_cond, cond_t, cond_a,
+                pol_gate, set_gate, pol_subject,
+            )
+        )
 
     # --------------------------------------- F-G: combine + last-set-wins
-    decision, cacheable = _combine_sets(
-        c, contrib_present, contrib_eff, contrib_cach
-    )
+    if explain:
+        decision, cacheable, win_s, have, s_sel_c = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach, explain=True
+        )
+    else:
+        decision, cacheable = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach
+        )
     status = jnp.int32(200)
 
     # condition aborts preempt everything, first in flat rule order
@@ -1029,7 +1108,30 @@ def _combine_and_decide(c: dict, reached, acl_rule, has_cond, cond_t,
     cacheable = jnp.where(has_abort, abort_cach, cacheable)
     status = jnp.where(has_abort, abort_code, status)
 
-    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+    if not explain:
+        return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+
+    # ------------------------------------------------- explain recovery
+    win_kp = jnp.take(s_sel_c, win_s)
+    win_flat = win_s * KP + win_kp
+    win_kr = jnp.take(sel_c.reshape(-1), win_flat)
+    no_rules_win = jnp.take(no_rules_contrib.reshape(-1), win_flat)
+    orig = c.get("rule_orig_flat")
+    if orig is None:
+        rule_pos = win_flat * KR + win_kr
+        abort_orig = abort_flat
+    else:
+        orig_f = orig.reshape(-1)
+        rule_pos = jnp.take(orig_f, win_flat * KR + win_kr)
+        abort_orig = jnp.take(orig_f, abort_flat)
+    expl = jnp.where(
+        have,
+        jnp.where(no_rules_win, (win_flat << 2) | 2, (rule_pos << 2) | 1),
+        0,
+    )
+    expl = jnp.where(has_abort, (abort_orig << 2) | 3, expl)
+    return (decision.astype(jnp.int32), cacheable, status.astype(jnp.int32),
+            expl.astype(jnp.int32))
 
 
 class DecisionKernel:
@@ -1044,13 +1146,21 @@ class DecisionKernel:
 
     def __init__(self, compiled: CompiledPolicies,
                  dynamic_policies: bool = False,
-                 shared_jits: Optional[dict] = None):
+                 shared_jits: Optional[dict] = None,
+                 explain: bool = False):
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
             )
         self.compiled = compiled
         self.dynamic_policies = dynamic_policies
+        # explain mode (docs/EXPLAIN.md): a 4th packed-provenance output
+        # per row.  The flag is part of the shared-jit key, so explain-off
+        # kernels keep their pre-explain executables byte-identical.
+        self.explain = bool(explain)
+        # (KP, KR) strides of the packed explain positions — the host
+        # decoder (srv/explain.py) maps flat positions back to tree slots
+        self.explain_strides = (compiled.KP, compiled.KR)
         self._shared = shared_jits if shared_jits is not None else {}
         # hrv_role/hrv_scope stay host-side (encode's owner-bit packer
         # consumes them; the device programs read only packed bitplanes)
@@ -1065,6 +1175,8 @@ class DecisionKernel:
 
         def make_run(with_acl: bool):
             key = ("dense", with_acl, with_hr)
+            if explain:
+                key = key + ("explain",)
             if dynamic_policies and key in self._shared:
                 jitted = self._shared[key]
                 return lambda *args: jitted(self._c, *args)
@@ -1078,7 +1190,8 @@ class DecisionKernel:
                 def one(ra, rs, pn, ct, ca, cc):
                     rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
                           "cond_true": ct, "cond_abort": ca, "cond_code": cc}
-                    return _evaluate_one(c, rr, with_acl, with_hr)
+                    return _evaluate_one(c, rr, with_acl, with_hr,
+                                         explain=explain)
 
                 return jax.vmap(one, in_axes=in_axes)(
                     batch_arrays, rgx_set, pfx_neq,

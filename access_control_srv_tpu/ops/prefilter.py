@@ -95,7 +95,7 @@ def _is_varying(name: str) -> bool:
     group-invariant policy/set metadata shared across the stack."""
     return (
         name in _RULE_FIELDS
-        or name in ("pol_target", "set_target")
+        or name in ("pol_target", "set_target", "rule_orig_flat")
         or name.startswith("t_")
     )
 
@@ -153,12 +153,20 @@ def candidate_rows(
 
 
 def compact_rules(
-    compiled: CompiledPolicies, row_cand: np.ndarray
+    compiled: CompiledPolicies, row_cand: np.ndarray,
+    explain: bool = False,
 ) -> CompiledPolicies:
     """Left-pack candidate rules along KR (order-preserving) and compact
     the target subtable to the rows the kept rules + all policy/set
     targets reference.  Mirrors parallel/rule_shard.py:partition_rules'
-    compaction, but driven by candidacy instead of chunk boundaries."""
+    compaction, but driven by candidacy instead of chunk boundaries.
+
+    ``explain=True`` additionally records ``rule_orig_flat`` [S, KP, KRp]:
+    each compacted slot's ORIGINAL flat rule position (s*KP + kp)*KR + kr,
+    so explain recovery (_combine_and_decide_flat) reports provenance in
+    pre-compaction coordinates.  Only materialized when asked — the array
+    would otherwise change the sig runner's argument pytree and with it
+    the lowered program bytes."""
     a = compiled.arrays
     cand = a["rule_valid"] & (~a["rule_has_target"] | row_cand[a["rule_target"]])
 
@@ -170,6 +178,15 @@ def compact_rules(
     for name in _RULE_FIELDS:
         new[name] = np.take_along_axis(a[name], order, axis=2)[:, :, :krp]
     new["rule_valid"] = np.take_along_axis(cand, order, axis=2)[:, :, :krp]
+    if explain:
+        S, KP, KR = a["rule_valid"].shape
+        base = (
+            np.arange(S, dtype=np.int64)[:, None, None] * KP
+            + np.arange(KP, dtype=np.int64)[None, :, None]
+        ) * KR
+        new["rule_orig_flat"] = (
+            base + order[:, :, :krp]
+        ).astype(np.int32)
 
     needed = set(
         np.unique(new["rule_target"][new["rule_valid"] & new["rule_has_target"]])
@@ -194,7 +211,7 @@ def compact_rules(
 
 def _pad_sub(arr: np.ndarray, name: str, krp: int, tp: int) -> np.ndarray:
     """Pad one compacted-subtree array to the stack's common KR/T."""
-    if name in _RULE_FIELDS:
+    if name in _RULE_FIELDS or name == "rule_orig_flat":
         width = krp - arr.shape[2]
         if width > 0:
             fill = (
@@ -223,7 +240,8 @@ class PrefilteredKernel:
                  mesh=None, axis: str = "data", max_groups: int = 512,
                  telemetry=None, dynamic_policies: bool = False,
                  shared_jits: Optional[dict] = None,
-                 staging: Optional[HostBufferPool] = None):
+                 staging: Optional[HostBufferPool] = None,
+                 explain: bool = False):
         """``mesh``: optional jax.sharding.Mesh — requests shard
         data-parallel over ``axis`` while the stacked subtrees and regex
         matrices replicate (the multi-chip layout of parallel/mesh.py
@@ -257,6 +275,10 @@ class PrefilteredKernel:
         self.max_groups = max_groups
         self.telemetry = telemetry
         self.dynamic_policies = dynamic_policies
+        self.explain = bool(explain)
+        # compacted slots decode through rule_orig_flat back to ORIGINAL
+        # coordinates, so host decode uses the uncompacted strides
+        self.explain_strides = (compiled.KP, compiled.KR)
         self._shared = shared_jits if shared_jits is not None else {}
         # pooled host staging (ops/staging.py): the packed sig-path row
         # buffer and the slot/readback maps recycle across batches so a
@@ -287,11 +309,13 @@ class PrefilteredKernel:
                 # a configured mesh is honored on every tree size
                 from ..parallel.mesh import ShardedDecisionKernel
 
-                self._dense = ShardedDecisionKernel(compiled, mesh, axis)
+                self._dense = ShardedDecisionKernel(
+                    compiled, mesh, axis, explain=self.explain
+                )
             else:
                 self._dense = DecisionKernel(
                     compiled, dynamic_policies=dynamic_policies,
-                    shared_jits=self._shared,
+                    shared_jits=self._shared, explain=self.explain,
                 )
         # hrv_role/hrv_scope are host-only since the owner-bitplane
         # rewrite (consumed by encode's packer, never by a device program)
@@ -301,7 +325,8 @@ class PrefilteredKernel:
         }
 
     def _runner(self, with_acl: bool, with_hr: bool):
-        key = (with_acl, with_hr)
+        explain = self.explain
+        key = (with_acl, with_hr) + (("explain",) if explain else ())
         run = self._runs.get(key)
         if run is None:
             def body(c_inv, cs, g_idx, batch_arrays, rgx_set, pfx_neq,
@@ -313,7 +338,8 @@ class PrefilteredKernel:
                          **jax.tree_util.tree_map(lambda x: x[g], cs)}
                     rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq,
                           "cond_true": ct, "cond_abort": ca, "cond_code": cc}
-                    return _evaluate_one(c, rr, with_acl, with_hr)
+                    return _evaluate_one(c, rr, with_acl, with_hr,
+                                         explain=explain)
 
                 return jax.vmap(one)(
                     g_idx, batch_arrays,
@@ -328,7 +354,8 @@ class PrefilteredKernel:
                 data = NamedSharding(self.mesh, P(self.axis))
                 cond = NamedSharding(self.mesh, P(None, self.axis))
                 shardings = ((repl, data, data, repl, repl,
-                              cond, cond, cond), (data, data, data))
+                              cond, cond, cond),
+                             (data,) * (4 if explain else 3))
             run = self._wrap_runner(("pref", key), body, shardings)
             self._runs[key] = run
         return run
@@ -393,7 +420,11 @@ class PrefilteredKernel:
         host->device transfer (the TPU tunnel pays per-transfer latency —
         ~35 small puts per call were costing ~10x the compute), and the
         three outputs return stacked as one [NSLOT, 3, R] readback."""
-        key = ("sig", schedule, needs_pairs, with_hr)
+        explain = self.explain
+        n_out = 4 if explain else 3
+        key = ("sig", schedule, needs_pairs, with_hr) + (
+            ("explain",) if explain else ()
+        )
         run = self._runs.get(key)
         if run is None:
             def sub_fold(r, n_sub, has_role, role, sub_ids, sub_vals):
@@ -551,12 +582,12 @@ class PrefilteredKernel:
                     return _combine_and_decide_flat(
                         c, reached, acl_rule, has_cond, cond_t, cond_a,
                         cond_c, pol_gate, set_gate,
-                        pol_subject=pol_subject,
+                        pol_subject=pol_subject, explain=explain,
                     )
 
-                out = jax.vmap(slot_fn)(slot_g, grid)  # [NSLOT, 3, R]
-                out_flat = out.transpose(0, 2, 1).reshape(NS * R, 3)
-                return jnp.take(out_flat, gp_orig, axis=0).T  # [3, B]
+                out = jax.vmap(slot_fn)(slot_g, grid)  # [NSLOT, n_out, R]
+                out_flat = out.transpose(0, 2, 1).reshape(NS * R, n_out)
+                return jnp.take(out_flat, gp_orig, axis=0).T  # [n_out, B]
 
             shardings = None
             if self.mesh is not None:
@@ -755,7 +786,7 @@ class PrefilteredKernel:
             rows = candidate_rows(
                 self.compiled, ent_ids, ent_cols, op_ids, act_vals, rgx_set
             )
-            sub = compact_rules(self.compiled, rows)
+            sub = compact_rules(self.compiled, rows, explain=self.explain)
             if len(self._subs) >= self.cache_size:
                 self._subs.pop(next(iter(self._subs)))
         else:
@@ -885,7 +916,8 @@ class PrefilteredKernel:
                         start = pos
                         seen = 1
             seg_slices.append(row_order[start:])
-            outs = [np.zeros((B,), np.int32) for _ in range(3)]
+            outs = [np.zeros((B,), np.int32)
+                    for _ in range(4 if self.explain else 3)]
             for idx in seg_slices:
                 sub_batch = RequestBatch(
                     B=len(idx),
@@ -1117,8 +1149,15 @@ class PrefilteredKernel:
                 run = self._sig_runner(
                     tuple(schedule), needs_pairs, with_hr=self.needs_hr
                 )
+                # rule_orig_flat rides along only in explain mode — adding
+                # it unconditionally would change the runner's argument
+                # pytree (and so the lowered program bytes) when off
+                c_keys = (
+                    _SIG_C_KEYS + ["rule_orig_flat"]
+                    if self.explain else _SIG_C_KEYS
+                )
                 cs = {k: v for k, v in stacked.items()
-                      if k in _SIG_C_KEYS}
+                      if k in c_keys}
                 # explicit async H2D put: handing the numpy buffers
                 # straight to pjit transfers them synchronously on the
                 # critical path (~10x slower for the packed buffer on the
@@ -1148,17 +1187,19 @@ class PrefilteredKernel:
                 pool.release_all(leases)
                 raise
 
+            n_out = 4 if self.explain else 3
+
             def materialize():
                 # the output fetch orders after every consumer of the
                 # inputs, so the staging leases are safe to recycle only
                 # AFTER this line — releasing earlier could leak rows
                 # between batches on the zero-copy CPU backend
                 _faults.fire("device.materialize")
-                out = np.asarray(out_dev)  # [3, b_pad]
+                out = np.asarray(out_dev)  # [n_out, b_pad]
                 if leases:
                     pool.release_all(leases)
                     leases.clear()
-                return tuple(out[i][:B] for i in range(3))
+                return tuple(out[i][:B] for i in range(n_out))
 
             return materialize
         run = self._runner(

@@ -97,7 +97,8 @@ def pad_batch(arrays: dict, B: int, multiple: int) -> tuple[dict, int]:
 class ShardedDecisionKernel:
     """The decision kernel jitted with batch-axis sharding over a mesh."""
 
-    def __init__(self, compiled: CompiledPolicies, mesh: Mesh, axis: str = "data"):
+    def __init__(self, compiled: CompiledPolicies, mesh: Mesh, axis: str = "data",
+                 explain: bool = False):
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported by kernel: {compiled.unsupported_reason}"
@@ -106,6 +107,8 @@ class ShardedDecisionKernel:
         self.mesh = mesh
         self.axis = axis
         self.n_devices = mesh.devices.size
+        self.explain = bool(explain)
+        self.explain_strides = (compiled.KP, compiled.KR)
         self._batch_sharding = NamedSharding(mesh, P(axis))
         self._repl = NamedSharding(mesh, P())
 
@@ -121,15 +124,11 @@ class ShardedDecisionKernel:
                     "cond_abort": ra["cond_abort"],
                     "cond_code": ra["cond_code"],
                 }
-                return _evaluate_one(c, rr)
+                return _evaluate_one(c, rr, explain=explain)
 
             return jax.vmap(one)(batch_arrays)
 
-        out_shardings = (
-            self._batch_sharding,
-            self._batch_sharding,
-            self._batch_sharding,
-        )
+        out_shardings = (self._batch_sharding,) * (4 if explain else 3)
         if bake_policy_constants(compiled):
             # small tree: bake as constants (see ops.kernel.DecisionKernel)
             c_const = {k: jnp.asarray(v) for k, v in compiled.arrays.items()}

@@ -178,27 +178,44 @@ def partition_sets(compiled: CompiledPolicies, n_shards: int
     )
 
 
-def _evaluate_set_chunk(c, r, s_offset, model_axis):
+def _evaluate_set_chunk(c, r, s_offset, model_axis, explain: bool = False):
     """Per-device evaluation of one SET chunk for one request.  Stages A-F
     run locally through the shared single-device helpers (whole sets are
     shard-local, so every combining algorithm is local); only the
     last-set-wins tail and the abort-first scan reduce across ``model``
     via packed positional keys (order-safe: unique positions in the high
-    bits, payload in the low bits)."""
+    bits, payload in the low bits).
+
+    ``explain=True`` appends the packed provenance output (ops/kernel
+    _combine_and_decide encoding, GLOBAL positions).  The winning set's
+    global position already rides in ``k_win``'s high bits, so the unique
+    owning shard recovers the full provenance locally and broadcasts the
+    packed code with one extra ``pmax`` (codes are >= 1 whenever any set
+    contributed; non-owners contribute 0)."""
     m = _match_targets(c, r)
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(
         c, r, m
     )
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
-    contrib_present, contrib_eff, contrib_cach, abort_rule = (
-        _policy_contributions(
+    if explain:
+        (contrib_present, contrib_eff, contrib_cach, abort_rule,
+         sel_c, no_rules_contrib) = _policy_contributions(
             c, reached, acl_rule, has_cond, cond_t, cond_a,
-            pol_gate, set_gate, pol_subject,
+            pol_gate, set_gate, pol_subject, explain=True,
         )
-    )
-    set_eff, set_cach, set_any = _per_set_effects(
-        c, contrib_present, contrib_eff, contrib_cach
-    )
+        set_eff, set_cach, set_any, s_sel_c = _per_set_effects(
+            c, contrib_present, contrib_eff, contrib_cach, explain=True
+        )
+    else:
+        contrib_present, contrib_eff, contrib_cach, abort_rule = (
+            _policy_contributions(
+                c, reached, acl_rule, has_cond, cond_t, cond_a,
+                pol_gate, set_gate, pol_subject,
+            )
+        )
+        set_eff, set_cach, set_any = _per_set_effects(
+            c, contrib_present, contrib_eff, contrib_cach
+        )
 
     # ---- last-set-wins across shards: pmax over packed positional keys
     S_l = set_eff.shape[0]
@@ -244,10 +261,40 @@ def _evaluate_set_chunk(c, r, s_offset, model_axis):
     decision = jnp.where(has_abort, 2, decision)
     cacheable = jnp.where(has_abort, abort_cach, cacheable)
     status = jnp.where(has_abort, abort_code, status)
+    if not explain:
+        return (
+            decision.astype(jnp.int32),
+            cacheable.astype(jnp.int32),
+            status.astype(jnp.int32),
+        )
+
+    # ---- explain recovery: the shard owning the winning set packs the
+    # provenance code locally; one pmax broadcasts it (codes >= 1 when
+    # any set contributed, so 0 from non-owners never wins)
+    win_s_local = jnp.argmax(k_set)
+    s_own = (jnp.max(k_set) == k_win) & have
+    win_flat = (s_offset + win_s_local) * KPn + jnp.take(s_sel_c, win_s_local)
+    win_kr = jnp.take(
+        sel_c.reshape(-1),
+        win_s_local * KPn + jnp.take(s_sel_c, win_s_local),
+    )
+    no_rules_win = jnp.take(
+        no_rules_contrib.reshape(-1),
+        win_s_local * KPn + jnp.take(s_sel_c, win_s_local),
+    )
+    rule_pos = win_flat * KRn + win_kr
+    expl_local = jnp.where(
+        s_own,
+        jnp.where(no_rules_win, (win_flat << 2) | 2, (rule_pos << 2) | 1),
+        0,
+    )
+    expl = jax.lax.pmax(expl_local.astype(jnp.int32), model_axis)
+    expl = jnp.where(has_abort, (abort_pos << 2) | 3, expl)
     return (
         decision.astype(jnp.int32),
         cacheable.astype(jnp.int32),
         status.astype(jnp.int32),
+        expl.astype(jnp.int32),
     )
 
 
@@ -267,6 +314,7 @@ class PodShardedKernel:
     def __init__(self, compiled: CompiledPolicies, mesh: Mesh,
                  data_axis: str = "data", model_axis: str = "model",
                  shared_jits: dict | None = None, prev_t_cap: int = 0,
+                 explain: bool = False,
                  _shards: list[ShardTables] | None = None,
                  _applied: list[int] | None = None):
         if not compiled.supported:
@@ -280,6 +328,8 @@ class PodShardedKernel:
         self.n_data = mesh.shape[data_axis]
         self.n_shards = mesh.shape[model_axis]
         self._shared = shared_jits if shared_jits is not None else {}
+        self.explain = bool(explain)
+        self.explain_strides = (compiled.KP, compiled.KR)
 
         if _shards is None:
             self.shards, self.s_local = partition_sets(
@@ -335,11 +385,14 @@ class PodShardedKernel:
         table (srv/evaluator.py) so patched/recompiled kernels with
         identical table shapes reuse the existing executables."""
         key = ("pod", self.model_axis, self.n_shards)
+        if self.explain:
+            key = key + ("explain",)
         jitted = self._shared.get(key)
         if jitted is not None:
             return jitted
 
         model_axis = self.model_axis
+        explain = self.explain
         c_specs = {k: P(model_axis) for k in self._c}
 
         def run(c, offsets, batch_arrays, rgx_set, pfx_neq):
@@ -348,7 +401,9 @@ class PodShardedKernel:
 
             def one(ra):
                 rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq}
-                return _evaluate_set_chunk(c_local, rr, s_offset, model_axis)
+                return _evaluate_set_chunk(
+                    c_local, rr, s_offset, model_axis, explain=explain
+                )
 
             return jax.vmap(one)(batch_arrays)
 
@@ -356,9 +411,7 @@ class PodShardedKernel:
             run,
             mesh=self.mesh,
             in_specs=(c_specs, P(model_axis), P(self.data_axis), P(), P()),
-            out_specs=(
-                P(self.data_axis), P(self.data_axis), P(self.data_axis)
-            ),
+            out_specs=(P(self.data_axis),) * (4 if explain else 3),
         )
         jitted = jax.jit(wrapped)
         self._shared[key] = jitted
@@ -388,7 +441,7 @@ class PodShardedKernel:
             new_compiled, self.mesh,
             data_axis=self.data_axis, model_axis=self.model_axis,
             shared_jits=self._shared, prev_t_cap=self.t_cap,
-            _shards=shards, _applied=applied,
+            explain=self.explain, _shards=shards, _applied=applied,
         )
 
     # ------------------------------------------------------------- identity

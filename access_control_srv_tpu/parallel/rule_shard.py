@@ -138,11 +138,18 @@ def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
     )
 
 
-def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
+def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis,
+                    explain: bool = False):
     """Per-device evaluation of one rule chunk for one request, with
     cross-``model`` packed positional reductions.  Stages A-D reuse the
     single-device kernel helpers against this shard's compacted target
-    subtable; only rule collection (E) and the abort scan differ."""
+    subtable; only rule collection (E) and the abort scan differ.
+
+    ``explain=True`` appends the packed provenance output (ops/kernel
+    _combine_and_decide encoding).  The cross-shard lattice reductions
+    already carry GLOBAL rule positions in the key high bits, so after
+    the pmin/pmax merges every device holds the winner's identity — the
+    explain code is recovered locally with zero extra collectives."""
     m = _match_targets(c, r)
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(c, r, m)
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
@@ -204,9 +211,14 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
 
     # ---- combine policy effects + last-set-wins (identical on every
     # device after the reductions)
-    decision, cacheable = _combine_sets(
-        c, contrib_present, contrib_eff, contrib_cach
-    )
+    if explain:
+        decision, cacheable, win_s, have, s_sel_c = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach, explain=True
+        )
+    else:
+        decision, cacheable = _combine_sets(
+            c, contrib_present, contrib_eff, contrib_cach
+        )
     status = jnp.int32(200)
 
     # ---- condition aborts: first in GLOBAL flat rule order
@@ -240,7 +252,25 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis):
     cacheable = jnp.where(has_abort, abort_cach, cacheable)
     status = jnp.where(has_abort, abort_code, status)
 
-    return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+    if not explain:
+        return decision.astype(jnp.int32), cacheable, status.astype(jnp.int32)
+
+    # ---- explain recovery (replicated): ``sel_key`` already merged the
+    # cross-shard reductions, so its high bits name the winning GLOBAL
+    # kr; strides for host decode are (KP, kr_total)
+    win_kp = jnp.take(s_sel_c, win_s)
+    win_flat = win_s * KPn + win_kp
+    win_kr_global = jnp.take(sel_key.reshape(-1), win_flat) >> 3
+    no_rules_win = jnp.take(no_rules_contrib.reshape(-1), win_flat)
+    rule_pos = win_flat * kr_total + win_kr_global
+    expl = jnp.where(
+        have,
+        jnp.where(no_rules_win, (win_flat << 2) | 2, (rule_pos << 2) | 1),
+        0,
+    )
+    expl = jnp.where(has_abort, (abort_pos << 2) | 3, expl)
+    return (decision.astype(jnp.int32), cacheable,
+            status.astype(jnp.int32), expl.astype(jnp.int32))
 
 
 class RuleShardedKernel:
@@ -259,7 +289,8 @@ class RuleShardedKernel:
     supports_delta = False
 
     def __init__(self, compiled: CompiledPolicies, mesh: Mesh,
-                 data_axis: str = "data", model_axis: str = "model"):
+                 data_axis: str = "data", model_axis: str = "model",
+                 explain: bool = False):
         if not compiled.supported:
             raise ValueError(
                 f"policy tree unsupported: {compiled.unsupported_reason}"
@@ -270,9 +301,13 @@ class RuleShardedKernel:
         self.model_axis = model_axis
         self.n_data = mesh.shape[data_axis]
         self.n_model = mesh.shape[model_axis]
+        self.explain = bool(explain)
 
         part = partition_rules(compiled, self.n_model)
         self._kr_total = part.kr_local * self.n_model
+        # decode strides: rule flat positions use the PADDED global kr
+        # extent, not compiled.KR (host decode must use these)
+        self.explain_strides = (compiled.KP, self._kr_total)
         self._c = {
             k: jax.device_put(
                 jnp.asarray(v), NamedSharding(mesh, P(model_axis))
@@ -293,7 +328,8 @@ class RuleShardedKernel:
             def one(ra):
                 rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq}
                 return _evaluate_chunk(
-                    c_local, rr, kr_offset, kr_total, model_axis
+                    c_local, rr, kr_offset, kr_total, model_axis,
+                    explain=explain,
                 )
 
             return jax.vmap(one)(batch_arrays)
@@ -304,7 +340,7 @@ class RuleShardedKernel:
             run,
             mesh=mesh,
             in_specs=(c_specs, P(model_axis), P(data_axis), P(), P()),
-            out_specs=(P(data_axis), P(data_axis), P(data_axis)),
+            out_specs=(P(data_axis),) * (4 if explain else 3),
         )
         self._run = jax.jit(wrapped)
 

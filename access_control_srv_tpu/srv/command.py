@@ -69,6 +69,7 @@ class CommandInterface:
             "program_identity": self.program_identity,
             "stage_stats": self.stage_stats,
             "faults": self.faults,
+            "shadow_status": self.shadow_status,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -186,6 +187,14 @@ class CommandInterface:
                 # device-health posture: quarantine state, timeout/restore
                 # counts, cumulative degraded seconds (srv/watchdog.py)
                 detail["device_watchdog"] = watchdog.status()
+            shadow = getattr(self.worker, "shadow", None)
+            if shadow is not None:
+                # candidate-tree staging posture: epoch, queue depth,
+                # evaluated/diff/drop counts (srv/shadow.py) — absent
+                # with shadow off, so the surface is unchanged
+                shadow_status = shadow.status()
+                shadow_status.pop("samples", None)  # health stays compact
+                detail["shadow"] = shadow_status
             from .faults import REGISTRY as _faults
 
             fault_stats = _faults.stats()
@@ -389,6 +398,28 @@ class CommandInterface:
         if action == "status":
             return REGISTRY.stats()
         return {"error": f"unknown faults action {action!r}"}
+
+    def shadow_status(self, payload: dict) -> dict:
+        """Shadow-evaluation report (srv/shadow.py): candidate epoch,
+        evaluated/diff/drop counts, diffs by decision transition, and the
+        retained diff samples with deciding-node provenance on both
+        sides.  ``{"drain": true}`` blocks briefly until the mirror queue
+        empties (policy-CI runs read a settled count); ``{"reload":
+        true}`` re-loads the candidate tree from its paths (or
+        ``candidate_paths``) and bumps the shadow epoch — production
+        serves on, untouched."""
+        shadow = getattr(self.worker, "shadow", None)
+        if shadow is None:
+            return {"enabled": False}
+        payload = payload or {}
+        if payload.get("reload"):
+            try:
+                shadow.reload(payload.get("candidate_paths"))
+            except Exception as err:  # noqa: BLE001 — report, keep serving
+                return {"enabled": True, "error": str(err)}
+        if payload.get("drain"):
+            shadow.drain(float(payload.get("drain_timeout_s", 5.0)))
+        return shadow.status()
 
     def stage_stats(self, payload: dict) -> dict:
         """Per-replica stage attribution for cluster benches: the stage

@@ -237,6 +237,32 @@ DEFAULT_CONFIG: dict = {
         "seed": 0,
         "points": [],
     },
+    # explain mode (srv/explain.py, docs/EXPLAIN.md).  Disabled by
+    # default: the kernels trace the exact pre-explain computation and
+    # the lowered device programs are byte-identical
+    # (tpu_compat_audit.py explain-shadow-program-identity).  Enabled:
+    # every kernel row carries one extra int32 naming the deciding node,
+    # decoded host-side onto the response (``_rule_id`` matching the
+    # oracle's EffectEvaluation.source bit-for-bit, plus the richer
+    # ``_explain`` dict) and into the decision-audit JSONL.
+    "explain": {"enabled": False},
+    # shadow evaluation (srv/shadow.py, docs/EXPLAIN.md): load a
+    # candidate policy tree beside production (same compiled programs —
+    # zero new XLA compiles, asserted) and replay live traffic against
+    # it off the response path, reporting decision diffs via the
+    # ``shadow_status`` command and acs_shadow_diffs_total.  A shadow
+    # decision can never alter, delay, or be cached as a production one.
+    "shadow": {
+        "enabled": False,
+        # YAML policy files forming the candidate tree
+        "candidate_paths": [],
+        # scope mirroring to one tenant's traffic (None = all)
+        "tenant": None,
+        # retained diff records with both-sides provenance
+        "sample_diffs": 32,
+        # bounded mirror queue (batches); overflow drops + counts
+        "queue_batches": 64,
+    },
     "logger": {"maskFields": ["password", "token"]},
 }
 

@@ -62,6 +62,7 @@ class HybridEvaluator:
         shared_jits: Optional[dict] = None,
         fixed_caps=None,
         tenant: Optional[str] = None,
+        explain: bool = False,
     ):
         self.engine = engine
         self.backend = backend
@@ -147,6 +148,13 @@ class HybridEvaluator:
         # scopes decision-cache keys/bumps so one tenant's mutations never
         # flush another's entries
         self.tenant = tenant
+        # explain mode (srv/explain.py): kernels emit one extra int32 per
+        # row naming the deciding node; decoded host-side onto the
+        # response (``_rule_id`` / ``_explain``).  OFF by default — the
+        # False path traces the exact pre-explain computation, so the
+        # lowered device program is byte-identical to explain-less builds.
+        self.explain = bool(explain) and backend != "oracle"
+        self._explain_decoder = None
         self._delta_counts = {
             "patches": 0, "full_compiles": 0, "noops": 0,
             "recompiles_avoided": 0, "fallbacks": 0,
@@ -290,6 +298,9 @@ class HybridEvaluator:
                 if self._version == claimed:
                     self._cand = cand
                     self._tree_snapshot = tree
+                    self._explain_decoder = self._make_explain_decoder(
+                        self._kernel, tree
+                    )
                     if new_state is not None:
                         self._delta_state = new_state
             self._delta_counts["noops"] += 1
@@ -315,10 +326,11 @@ class HybridEvaluator:
             kernel = PrefilteredKernel(
                 patched, mesh=self.mesh, axis=self.mesh_axis,
                 telemetry=self.telemetry, dynamic_policies=True,
-                shared_jits=self._shared_jits,
+                shared_jits=self._shared_jits, explain=self.explain,
             )
         native_encoder = self._make_native_encoder(patched, kernel)
         cand = self._build_candidate_index()
+        explain_decoder = self._make_explain_decoder(kernel, tree)
         with self._lock:
             if self._version != claimed:
                 return False  # a newer refresh superseded this patch
@@ -330,6 +342,7 @@ class HybridEvaluator:
             self._tree_snapshot = tree
             self._native_encoder = native_encoder
             self._cand = cand
+            self._explain_decoder = explain_decoder
             self._delta_state = new_state
         if self.decision_cache is not None:
             # post-swap bump, scoped to the delta's footprint: entries
@@ -504,6 +517,7 @@ class HybridEvaluator:
                     compiled, self.mesh,
                     data_axis=self.mesh_axis,
                     model_axis=self.model_axis or "model",
+                    explain=self.explain,
                     shared_jits=self._shared_jits,
                     prev_t_cap=getattr(prev, "t_cap", 0),
                 )
@@ -520,6 +534,7 @@ class HybridEvaluator:
                     compiled, self.mesh,
                     data_axis=self.mesh_axis,
                     model_axis=self.model_axis,
+                    explain=self.explain,
                 )
             else:
                 # PrefilteredKernel is a drop-in DecisionKernel that
@@ -533,9 +548,11 @@ class HybridEvaluator:
                     telemetry=self.telemetry,
                     dynamic_policies=self.delta_enabled,
                     shared_jits=self._shared_jits,
+                    explain=self.explain,
                 )
         native_encoder = self._make_native_encoder(compiled, kernel)
         cand = self._build_candidate_index()
+        explain_decoder = self._make_explain_decoder(kernel, tree_snapshot)
         with self._lock:
             if version >= self._version:  # drop stale compiles
                 self._compiled = compiled
@@ -545,6 +562,7 @@ class HybridEvaluator:
                 self._native_encoder = native_encoder
                 self._cand = cand
                 self._caps = caps
+                self._explain_decoder = explain_decoder
                 self._delta_state = state
         self._delta_counts["full_compiles"] += 1
         self._count_delta("full-compile")
@@ -584,10 +602,30 @@ class HybridEvaluator:
 
         return (live_tree, CandidateIndex(live_tree, self.engine.urns))
 
+    def _make_explain_decoder(self, kernel, tree):
+        """ExplainDecoder paired with one published kernel, built from the
+        same version-pinned tree snapshot as the compiled arrays; None
+        when explain is off or the kernel cannot emit provenance."""
+        if (not self.explain or kernel is None
+                or not getattr(kernel, "explain", False)):
+            return None
+        from .explain import ExplainDecoder, explain_capacity_ok
+
+        compiled = getattr(kernel, "compiled", None)
+        if compiled is not None:
+            assert explain_capacity_ok(
+                compiled.S, compiled.KP, compiled.KR
+            ), "policy tree exceeds the explain code's 30-bit position bound"
+        return ExplainDecoder(tree, kernel.explain_strides)
+
     def _make_native_encoder(self, compiled, kernel):
         """C++ wire-batch encoder for the gRPC fast path; None when the
-        native library or the tree shape does not support it."""
-        if kernel is None or compiled.conditions:
+        native library or the tree shape does not support it.  Explain
+        mode also disables it: wire batches carry no Response objects to
+        stamp provenance on, so explain-enabled serving routes gRPC
+        through the pb decode path instead of silently dropping the
+        deciding-rule attribution."""
+        if kernel is None or compiled.conditions or self.explain:
             return None
         try:
             from .. import native
@@ -650,7 +688,7 @@ class HybridEvaluator:
             [canary], compiled, self.engine.resource_adapter
         )
         outputs = kernel.evaluate_async(batch)()
-        return len(outputs) == 3
+        return len(outputs) >= 3  # explain-enabled kernels append a 4th
 
     def _hang_fallback(self, requests: list) -> list:
         """Honest per-row resolution for a batch whose device materialize
@@ -739,7 +777,7 @@ class HybridEvaluator:
         materialize = self._guard_materialize(kernel.evaluate_async(batch))
 
         def finalize():
-            decision, cacheable, status = materialize()
+            decision, cacheable, status = materialize()[:3]
             if tracer is not None:
                 from .tracing import STAGE_DEVICE
 
@@ -761,7 +799,7 @@ class HybridEvaluator:
                 )
                 d2, c2, s2 = self._guard_materialize(
                     kernel.evaluate_async(retry)
-                )()
+                )()[:3]
                 # kernel outputs are read-only views on device buffers
                 decision = np.array(decision)
                 cacheable = np.array(cacheable)
@@ -996,11 +1034,24 @@ class HybridEvaluator:
         identity guard and the index consistent under concurrent swaps."""
         cand = self._cand
         if cand is not None and cand[0] is self.engine.policy_sets:
-            return self.engine.is_allowed(
+            response = self.engine.is_allowed(
                 request,
                 candidate_rules=cand[1].candidates(request, self.engine.urns),
             )
-        return self.engine.is_allowed(request)
+        else:
+            response = self.engine.is_allowed(request)
+        decoder = self._explain_decoder
+        if decoder is not None and getattr(response, "_explain", None) is None:
+            # oracle rows carry the same ``_explain`` shape as kernel
+            # rows (reverse-lookup of the engine's source stamp), so the
+            # wire trailer / audit surface never depends on which path
+            # decided a row.  None when explain is off — zero new work.
+            info = decoder.describe_source(
+                getattr(response, "_rule_id", None)
+            )
+            if info is not None:
+                response._explain = info
+        return response
 
     def what_is_allowed(self, request):
         return self.engine.what_is_allowed(request)
@@ -1198,6 +1249,9 @@ class HybridEvaluator:
         with self._lock:
             kernel = self._kernel
             compiled = self._compiled
+            # paired with the kernel under the same lock: provenance must
+            # decode against the tree the serving program was lowered from
+            decoder = self._explain_decoder
         if self.backend == "oracle" or kernel is None or self._quarantined:
             # candidate-filtered like every other oracle path (skipped
             # rules provably cannot target-match; bit-identical) — the
@@ -1229,7 +1283,8 @@ class HybridEvaluator:
                 # same device queue), then finalize in dispatch order
                 fins = [
                     (rows, self._eval_encoded_async(
-                        kernel, compiled, [requests[b] for b in rows], caps
+                        kernel, compiled, [requests[b] for b in rows], caps,
+                        decoder=decoder,
                     ))
                     for rows, caps in ((floor_rows, dict(_CAPS_FLOOR)),
                                        (ext, None))
@@ -1243,12 +1298,18 @@ class HybridEvaluator:
                     return out
 
                 return finalize_split
-        return self._eval_encoded_async(kernel, compiled, requests, None)
+        return self._eval_encoded_async(
+            kernel, compiled, requests, None, decoder=decoder
+        )
 
-    def _eval_encoded(self, kernel, compiled, requests: list, caps):
-        return self._eval_encoded_async(kernel, compiled, requests, caps)()
+    def _eval_encoded(self, kernel, compiled, requests: list, caps,
+                      decoder=None):
+        return self._eval_encoded_async(
+            kernel, compiled, requests, caps, decoder=decoder
+        )()
 
-    def _eval_encoded_async(self, kernel, compiled, requests: list, caps):
+    def _eval_encoded_async(self, kernel, compiled, requests: list, caps,
+                            decoder=None):
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
         batch = encode_requests(
@@ -1268,13 +1329,18 @@ class HybridEvaluator:
             except DeviceTimeoutError:
                 return self._hang_fallback(requests)
             return self._decode_batch(
-                requests, batch, outputs, tracer, t_device
+                requests, batch, outputs, tracer, t_device, decoder=decoder
             )
 
         return finalize
 
-    def _decode_batch(self, requests, batch, outputs, tracer, t_device):
-        decision, cacheable, status = outputs
+    def _decode_batch(self, requests, batch, outputs, tracer, t_device,
+                      decoder=None):
+        decision, cacheable, status = outputs[:3]
+        # explain mode: 4th kernel output packs the deciding node's slot
+        # position; decoded per kernel-path row below (srv/explain.py)
+        expl = outputs[3] if decoder is not None and len(outputs) > 3 \
+            else None
         t_stage = 0.0
         if tracer is not None:
             from .tracing import STAGE_DEVICE
@@ -1311,14 +1377,22 @@ class HybridEvaluator:
                 }
                 if len(msgs) == 1 and None not in msgs:
                     cach = None if cacheable[b] < 0 else bool(cacheable[b])
-                    responses.append(Response(
+                    resp = Response(
                         decision=Decision.DENY,
                         obligations=[],
                         evaluation_cacheable=cach,
                         operation_status=OperationStatus(
                             code=int(status[b]), message=msgs.pop()
                         ),
-                    ))
+                    )
+                    if expl is not None:
+                        # the richer explain dict names the aborting rule;
+                        # no ``_rule_id`` — the oracle's abort response
+                        # carries no provenance either (host parity)
+                        info = decoder.decode(expl[b])
+                        if info is not None:
+                            resp._explain = info
+                    responses.append(resp)
                     continue
             if not batch.eligible[b] or status[b] != 200:
                 # ineligible rows (and ambiguous abort rows) take the
@@ -1329,14 +1403,23 @@ class HybridEvaluator:
                 responses.append(None)
                 continue
             cach = None if cacheable[b] < 0 else bool(cacheable[b])
-            responses.append(
-                Response(
-                    decision=DECISION_NAMES[int(decision[b])],
-                    obligations=[],
-                    evaluation_cacheable=cach,
-                    operation_status=OperationStatus(),
-                )
+            resp = Response(
+                decision=DECISION_NAMES[int(decision[b])],
+                obligations=[],
+                evaluation_cacheable=cach,
+                operation_status=OperationStatus(),
             )
+            if expl is not None:
+                info = decoder.decode(expl[b])
+                if info is not None:
+                    resp._explain = info
+                source = decoder.source(expl[b])
+                if source is not None:
+                    # identical to the oracle's EffectEvaluation.source
+                    # stamp (core/engine.py) — the audit log and the
+                    # transports read the same attribute either way
+                    resp._rule_id = source
+            responses.append(resp)
         if tracer is not None:
             from .tracing import STAGE_DECODE
 

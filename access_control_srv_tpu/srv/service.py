@@ -80,6 +80,24 @@ class AccessControlService:
         # when set (Worker wires it), concurrent single isAllowed calls are
         # coalesced into kernel batches instead of hitting the oracle 1-by-1
         self.batcher = None
+        # shadow evaluator (srv/shadow.py): when set, served decisions
+        # mirror onto the candidate tree AFTER response assembly.  None
+        # (the default) keeps both endpoints byte-identical — the taps
+        # are one attribute test each.
+        self.shadow = None
+
+    def _shadow_tap(self, requests: list, responses: list) -> None:
+        """Mirror served rows to the shadow.  Post-decision, non-blocking
+        (bounded drop-queue inside), and exception-proofed twice over —
+        nothing here can alter or delay what was already decided."""
+        shadow = self.shadow
+        if shadow is None:
+            return
+        try:
+            shadow.submit(requests, responses)
+        except Exception:  # noqa: BLE001 — shadow must never fail serving
+            if self.logger:
+                self.logger.exception("shadow mirror failed")
 
     def _observed_request(self, req):
         """(span, own_span): the transport-attached span if any, else a
@@ -169,6 +187,7 @@ class AccessControlService:
                 response = self.engine.is_allowed(req)
             self._observe("is_allowed_latency", t0, (response.decision,))
             self._finish_observed(req, response, span, own_span)
+            self._shadow_tap([req], [response])
             return response
         except Exception as err:
             if self.logger:
@@ -230,6 +249,7 @@ class AccessControlService:
                         )
                     except Exception:  # noqa: BLE001 — never fail serving
                         pass
+            self._shadow_tap(reqs, responses)
             return responses
         except Exception as err:
             # same deny-on-exception contract as the single-request path

@@ -611,6 +611,12 @@ class Telemetry:
         # counts, fed by the registry's on_hit hook — operators see
         # exactly which failpoints fired and how often
         self.failpoints = Counter()
+        # shadow evaluation (srv/shadow.py): candidate-vs-production
+        # decision diffs keyed by transition ("PERMIT->DENY", ...) plus
+        # lifecycle events (evaluated/dropped/errors).  Both stay empty —
+        # and the snapshot block absent — unless a shadow is loaded.
+        self.shadow_diffs = Counter()
+        self.shadow = Counter()
         # per-tenant serving events (srv/tenancy.py): decision / shed /
         # cache_hit / cache_miss per tenant id, cardinality-bounded —
         # see TenantCounter
@@ -677,6 +683,14 @@ class Telemetry:
         reg.counter("acs_failpoint_hits_total",
                     "Deterministic fault-injection hits per site "
                     "(srv/faults.py)", self.failpoints, label="site")
+        reg.counter("acs_shadow_diffs_total",
+                    "Candidate-vs-production decision diffs by transition "
+                    "(srv/shadow.py)", self.shadow_diffs,
+                    label="transition")
+        reg.counter("acs_shadow_events_total",
+                    "Shadow-evaluation lifecycle events "
+                    "(evaluated/dropped/errors)", self.shadow,
+                    label="event")
         reg.gauge("acs_degraded_seconds",
                   "Cumulative seconds the device kernel path has been "
                   "quarantined (srv/watchdog.py)", self._degraded_seconds)
@@ -796,6 +810,10 @@ class Telemetry:
             # was served — untenanted workers keep the exact legacy shape
             if tenant_events:
                 out["tenants"] = tenant_events
+            shadow_events = self.shadow.snapshot()
+            shadow_diffs = self.shadow_diffs.snapshot()
+            if shadow_events or shadow_diffs:
+                out["shadow"] = {**shadow_events, "diffs": shadow_diffs}
             if faults_enabled or failpoint_hits:
                 out["failpoints"] = {
                     "enabled": faults_enabled,

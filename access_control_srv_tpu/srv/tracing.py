@@ -25,10 +25,13 @@ echoed on the response's trailing metadata.
 
 EXTree (PAPERS.md) argues ABAC decisions must be auditable after the
 fact: ``DecisionAuditLog`` emits a sampled JSONL record per decision
-(subject/resource/action/decision/serving path/deciding rule id where
-the host path knows it) through the same masking machinery as the
-structured logger — secret-named fields AND secret-named target
-attributes (token and friends) never reach the sink.
+(subject/resource/action/decision/serving path/deciding rule id) through
+the same masking machinery as the structured logger — secret-named
+fields AND secret-named target attributes (token and friends) never
+reach the sink.  Oracle rows carry the host walk's provenance; with
+explain mode on (``explain:enabled``, srv/explain.py) kernel rows
+carry the device-recovered deciding rule id through the identical
+``_rule_id`` attribute and the identical masking path.
 
 Everything here is host-only BY CONSTRUCTION: this module never imports
 jax (statically asserted by tpu_compat_audit.py row
@@ -226,8 +229,9 @@ class DecisionAuditLog:
     """Sampled JSONL decision-audit sink riding the masking logger
     machinery: one JSON object per sampled decision with subject /
     resource / action / decision / serving path / deciding rule id
-    (where the host path knows it — the oracle walk; kernel rows carry
-    null until the explain-mode kernel outputs land).  Masking is
+    (oracle rows from the host walk's ``EffectEvaluation.source``;
+    kernel rows from the explain-mode kernel output when
+    ``explain:enabled`` is on, null otherwise).  Masking is
     double-layered: the record passes MaskingFilter (secret-named dict
     keys) AND target attributes whose ``id`` matches a mask field have
     their VALUE replaced before the record is built — a subject token

@@ -435,21 +435,42 @@ def resolve_fallback_rows(worker, responses: list, fallback_rows: list,
 
 POLICY_EPOCH_METADATA_KEY = "x-acs-policy-epoch"
 SHED_METADATA_KEY = "x-acs-shed"
+EXPLAIN_METADATA_KEY = "x-acs-explain"
 # admission-control shed statuses (srv/admission.py): 429 queue-full,
 # 503 breaker-open, 504 deadline-infeasible
 SHED_CODES = frozenset((429, 503, 504))
 
 
-def stamp_trailers(context, worker, trace_id=None, shed=False):
+def explain_trailer(response) -> Optional[str]:
+    """Compact JSON of the deciding-node provenance (srv/explain.py)
+    when explain mode stamped the response, else None.  The
+    io.restorecommerce Response proto has no provenance field, so the
+    wire surface is a trailer — additive metadata keeps the response
+    bytes identical for every consumer that doesn't opt in."""
+    info = getattr(response, "_explain", None)
+    if info is None:
+        return None
+    try:
+        return json.dumps(info, separators=(",", ":"), sort_keys=True)
+    except Exception:  # noqa: BLE001 — stamping never fails a request
+        return None
+
+
+def stamp_trailers(context, worker, trace_id=None, shed=False,
+                   explain=None):
     """Set the response's trailing metadata in ONE call (grpc's
     set_trailing_metadata overwrites, so every stamp merges here):
     ``x-acs-policy-epoch`` — the replica's policy epoch, letting the
     cluster router (srv/router.py) track per-replica convergence from
     live traffic without polling; ``x-acs-shed`` — the whole request
     was shed by admission control, so the router may retry it on
-    another replica without parsing response bytes; plus the trace-id
-    echo (srv/tracing.py) when the request was sampled."""
+    another replica without parsing response bytes; ``x-acs-explain``
+    — deciding-node provenance JSON when explain mode is on
+    (docs/EXPLAIN.md); plus the trace-id echo (srv/tracing.py) when
+    the request was sampled."""
     md = []
+    if explain:
+        md.append((EXPLAIN_METADATA_KEY, explain))
     epoch_fn = getattr(worker, "policy_epoch", None)
     if epoch_fn is not None:
         try:
@@ -520,6 +541,7 @@ class GrpcServer:
                 stamp_trailers(
                     context, worker,
                     shed=response.operation_status.code in SHED_CODES,
+                    explain=explain_trailer(response),
                 )
                 return response_to_pb(response)
             # traced path: span at transport receive (trace id from the
@@ -548,6 +570,7 @@ class GrpcServer:
                 context, worker,
                 trace_id=span.trace_id if span is not None else None,
                 shed=response.operation_status.code in SHED_CODES,
+                explain=explain_trailer(response),
             )
             if span is not None:
                 tracer.finish(span, decision=response.decision,

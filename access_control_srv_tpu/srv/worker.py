@@ -350,6 +350,10 @@ class Worker:
             decision_cache=self.decision_cache,
             delta_enabled=bool(cfg.get("evaluator:delta_enabled", True)),
             observability=self.obs,
+            # explain mode (srv/explain.py): kernel rows carry deciding-
+            # node provenance.  False (the default) lowers the exact
+            # pre-explain device program.
+            explain=bool(cfg.get("explain:enabled", False)),
         )
 
         # deterministic fault injection (srv/faults.py): arm the process
@@ -503,9 +507,31 @@ class Worker:
                     cfg.get("replication:catchup_timeout_s", 60.0)
                 )
             )
+
+        # shadow evaluation (srv/shadow.py): candidate tree beside
+        # production on the same compiled programs, fed from the service
+        # facade off the response path.  Built LAST so the production
+        # tree (and so its size class and shared jit registry) is final
+        # — the zero-new-compiles assertion inside compares against the
+        # fully-warmed state.  None unless shadow:enabled with
+        # candidate_paths (the default): no object, no queue, no tap.
+        from . import shadow as shadow_mod
+
+        self.shadow = shadow_mod.from_config(
+            cfg, self.evaluator,
+            telemetry=self.telemetry, logger=self.logger,
+        )
+        self.service.shadow = self.shadow
         return self
 
     def stop(self) -> None:
+        if getattr(self, "shadow", None) is not None:
+            # stop mirroring before the serving teardown below: the
+            # facade tap checks for None, and the shadow owns its own
+            # evaluator (joined here, never by the production shutdown)
+            self.service.shadow = None
+            self.shadow.stop()
+            self.shadow = None
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.close()
         if getattr(self, "_faults_armed", False):

@@ -73,7 +73,10 @@ _CONFIG_KNOBS = (
     "CLUSTER_BATCH", "CLUSTER_CALLS", "CLUSTER_CLIENTS",
     "CLUSTER_UNARY_PROBES", "DEGRADED_RULES", "DEGRADED_BATCH",
     "DEGRADED_DURATION_S", "SHARD_RULES", "SHARD_BATCH", "SHARD_CALLS",
-    "SHARD_MUTATIONS", "SHARD_COUNTS",
+    "SHARD_MUTATIONS", "SHARD_COUNTS", "EXPLAIN_RULES", "EXPLAIN_TOTAL",
+    "EXPLAIN_CHUNK", "SHADOW_RULES", "SHADOW_DURATION_S", "SHADOW_WARMUP_S",
+    "SHADOW_WARMUP_MAX_S", "SHADOW_DEADLINE_MS", "SHADOW_CLIENTS",
+    "SHADOW_FLIP_EVERY", "SHADOW_QUEUE",
 )
 
 
@@ -596,16 +599,18 @@ def bench_hr_deep():
 # ------------------------------------------------- config 5: 100k-rule stress
 
 
-def _stress_engine(n_rules: int, scoped: bool = False,
-                   cacheable: bool = False):
-    """Synthetic tree: deny-overrides set of permit-overrides policies,
-    role/entity/action-targeted rules with interleaved PERMIT/DENY.
-    ``scoped=True`` adds a roleScopingEntity to every rule's role subject
-    (stage B non-trivial tree-wide: the enterprise shape).
-    ``cacheable=True`` marks every rule ``evaluation_cacheable`` (the
-    decision-cache warm-traffic shape)."""
-    from access_control_srv_tpu.core.loader import load_policy_sets
-    from access_control_srv_tpu.core import AccessController
+def _stress_doc(n_rules: int, scoped: bool = False, cacheable: bool = False,
+                flip_every: int = 0):
+    """The synthetic stress tree as a nested ``policy_sets`` document
+    (the loader's file shape): deny-overrides set of permit-overrides
+    policies, role/entity/action-targeted rules with interleaved
+    PERMIT/DENY.  ``scoped=True`` adds a roleScopingEntity to every
+    rule's role subject (stage B non-trivial tree-wide: the enterprise
+    shape).  ``cacheable=True`` marks every rule
+    ``evaluation_cacheable`` (the decision-cache warm-traffic shape).
+    ``flip_every=N`` inverts the effect of every Nth rule — the
+    shadow-diff bench's candidate tree: identical size class, known
+    deliberate divergences."""
     from access_control_srv_tpu.models import Urns
 
     urns = Urns()
@@ -627,6 +632,9 @@ def _stress_engine(n_rules: int, scoped: bool = False,
                     "id": urns["roleScopingEntity"],
                     "value": ORG,
                 })
+            effect = "PERMIT" if rid % 3 else "DENY"
+            if flip_every and rid % flip_every == 0:
+                effect = "DENY" if effect == "PERMIT" else "PERMIT"
             rules.append(
                 {
                     "id": f"r{rid}",
@@ -638,7 +646,7 @@ def _stress_engine(n_rules: int, scoped: bool = False,
                              "value": actions[rid % len(actions)]}
                         ],
                     },
-                    "effect": "PERMIT" if rid % 3 else "DENY",
+                    "effect": effect,
                     "evaluation_cacheable": cacheable,
                 }
             )
@@ -651,6 +659,16 @@ def _stress_engine(n_rules: int, scoped: bool = False,
             {"id": "stress", "combining_algorithm": DO, "policies": policies}
         ]
     }
+    return doc, rid
+
+
+def _stress_engine(n_rules: int, scoped: bool = False,
+                   cacheable: bool = False):
+    """``_stress_doc`` loaded into an engine; see its docstring."""
+    from access_control_srv_tpu.core import AccessController
+    from access_control_srv_tpu.core.loader import load_policy_sets
+
+    doc, rid = _stress_doc(n_rules, scoped=scoped, cacheable=cacheable)
     engine = AccessController()
     for ps in load_policy_sets(doc):
         engine.update_policy_set(ps)
@@ -2542,6 +2560,306 @@ def bench_tenant_scale():
     )
 
 
+def bench_explain_overhead():
+    """Explain-mode cost (srv/explain.py, docs/EXPLAIN.md): the same
+    20k-rule tree and traffic evaluated with and without the fourth
+    per-row provenance output on the sig path.  The bar is <20%
+    throughput overhead — the provenance plane rides the existing
+    combining passes as one extra int32 reduction, never a second
+    evaluation — with a bit-for-bit oracle parity spot-check before any
+    timing (a fast wrong answer is not a result)."""
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.ops import (
+        PrefilteredKernel,
+        compile_policies,
+        encode_requests,
+    )
+    from access_control_srv_tpu.srv.explain import ExplainDecoder
+
+    urns = Urns()
+    n_rules = int(os.environ.get("EXPLAIN_RULES", 20_000))
+    total = int(os.environ.get("EXPLAIN_TOTAL", 1 << 15))
+    chunk = int(os.environ.get("EXPLAIN_CHUNK", 4096))
+
+    engine, actual_rules = _stress_engine(n_rules)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+
+    rng = np.random.default_rng(7)
+    requests = []
+    for i in range(chunk):
+        # same draw as bench_stress: bulk matched traffic + 10-20% misses
+        role = f"role-{int(rng.integers(108))}"
+        k = int(rng.integers(72))
+        entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+        requests.append(
+            Request(
+                target=Target(
+                    subjects=[
+                        Attribute(id=urns["role"], value=role),
+                        Attribute(id=urns["subjectID"], value=f"u{i}"),
+                    ],
+                    resources=[
+                        Attribute(id=urns["entity"], value=entity),
+                        Attribute(id=urns["resourceID"], value=f"res-{i}"),
+                    ],
+                    actions=[
+                        Attribute(
+                            id=urns["actionID"],
+                            value=[urns["read"], urns["modify"],
+                                   urns["create"], urns["delete"]][i % 4],
+                        )
+                    ],
+                ),
+                context={
+                    "resources": [],
+                    "subject": {
+                        "id": f"u{i}",
+                        "role_associations": [{"role": role, "attributes": []}],
+                        "hierarchical_scopes": [],
+                    },
+                },
+            )
+        )
+    batch = encode_requests(requests, compiled)
+
+    kern_off = PrefilteredKernel(compiled)
+    kern_on = PrefilteredKernel(compiled, explain=True)
+
+    # provenance parity spot-check against the host oracle (the full
+    # differential suite is tests/test_explain.py; this guards the bench
+    # itself against measuring a broken kernel)
+    out = kern_on.evaluate(batch)
+    assert len(out) == 4, "explain=True must emit the provenance output"
+    dec, _, _, exp = out
+    decoder = ExplainDecoder(engine.policy_sets, kern_on.explain_strides)
+    code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    for i in range(0, chunk, max(1, chunk // 16)):
+        expected = engine.is_allowed(requests[i])
+        assert dec[i] == code[expected.decision], (i, dec[i])
+        got = decoder.source(int(exp[i]))
+        want = getattr(expected, "_rule_id", None)
+        assert got == want, (i, got, want)
+
+    def timed(kernel):
+        kernel.evaluate(batch)  # warmup: per-signature subtree compiles
+        iters = max(1, total // chunk)
+        t0 = time.perf_counter()
+        pending = []
+        for _ in range(iters):
+            if len(pending) >= 3:
+                pending.pop(0)()
+            pending.append(kernel.evaluate_async(batch))
+        for p in pending:
+            p()
+        return chunk * iters / (time.perf_counter() - t0)
+
+    off_rps = timed(kern_off)
+    on_rps = timed(kern_on)
+    overhead_pct = (off_rps / on_rps - 1.0) * 100.0
+    return _result(
+        f"isAllowed decisions/sec/chip with explain provenance "
+        f"({actual_rules}-rule tree)",
+        on_rps,
+        "decisions/s",
+        {
+            "rules": actual_rules,
+            "batch": chunk,
+            "explain_off_rps": round(off_rps, 1),
+            "overhead_pct": round(overhead_pct, 1),
+            "overhead_ok": bool(overhead_pct < 20.0),
+            "bar": "explain-on throughput within 20% of explain-off on "
+                   "the same tree and traffic; provenance spot-checked "
+                   "against the host oracle before timing",
+        },
+    )
+
+
+def bench_shadow_diff():
+    """Shadow evaluation under live traffic (srv/shadow.py,
+    docs/EXPLAIN.md): a candidate tree with deliberately flipped rule
+    effects rides beside production on the SAME compiled device
+    programs while closed-loop clients drive the admission-gated
+    serving facade.  The bar is the honesty contract: zero new XLA
+    programs for the shadow (asserted at attach), flipped decisions
+    surface as transition-keyed diffs, and the production path stays
+    untouched — admitted p99 within the deadline bound; overflow drops
+    SHADOW work (counted), never a production decision."""
+    import tempfile
+    import threading as _threading
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+    from access_control_srv_tpu.srv.shadow import ShadowEvaluator
+
+    urns = Urns()
+    n_rules = int(os.environ.get("SHADOW_RULES", 20_000))
+    duration_s = float(os.environ.get("SHADOW_DURATION_S", 3.0))
+    warmup_s = float(os.environ.get("SHADOW_WARMUP_S", 1.0))
+    warmup_max_s = float(os.environ.get("SHADOW_WARMUP_MAX_S", 60.0))
+    # explicit bound, or self-sized after warmup (the CPU fallback's
+    # per-batch kernel latency is orders slower than on-chip; a fixed
+    # default would either reject everything there or be vacuous on-chip)
+    deadline_env = os.environ.get("SHADOW_DEADLINE_MS")
+    deadline_ms = float(deadline_env) if deadline_env else 250.0
+    clients = int(os.environ.get("SHADOW_CLIENTS", 8))
+    flip_every = int(os.environ.get("SHADOW_FLIP_EVERY", 7))
+    queue_batches = int(os.environ.get("SHADOW_QUEUE", 64))
+
+    worker, _, _ = _serving_worker(n_rules, serve_grpc=False, cfg_extra={
+        # the cache would absorb the repeat traffic and measure nothing
+        "decision_cache": {"enabled": False},
+        "admission": {
+            "enabled": True,
+            "deadline_bound_ms": deadline_ms,
+            "min_batch": 8,
+        },
+    })
+    try:
+        # candidate = the production stress tree with every Nth effect
+        # inverted: identical size class by construction, so the shadow
+        # attach proves program identity, and every flip that decides a
+        # request is a guaranteed diff
+        doc, _ = _stress_doc(n_rules, flip_every=flip_every)
+        cand_dir = tempfile.mkdtemp(prefix="acs-shadow-bench-")
+        cand_path = os.path.join(cand_dir, "candidate.yml")
+        with open(cand_path, "w") as fh:
+            json.dump(doc, fh)  # JSON is a YAML subset; the loader is yaml
+        # attach AFTER the stress corpus landed (production tree and its
+        # capacity class are final) — mirrors worker.start()'s ordering
+        shadow = ShadowEvaluator(
+            worker.evaluator, [cand_path],
+            telemetry=worker.telemetry, logger=worker.logger,
+            queue_batches=queue_batches,
+        )
+        worker.shadow = shadow
+        worker.service.shadow = shadow
+
+        def make_request(i):
+            role = f"role-{i % 108}"
+            k = i % 64
+            entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+            return Request(
+                target=Target(
+                    subjects=[Attribute(id=urns["role"], value=role),
+                              Attribute(id=urns["subjectID"], value=f"u{i}")],
+                    resources=[Attribute(id=urns["entity"], value=entity),
+                               Attribute(id=urns["resourceID"],
+                                         value=f"r{i}")],
+                    actions=[Attribute(id=urns["actionID"],
+                                       value=urns["read"])],
+                ),
+                context={"resources": [], "subject": {
+                    "id": f"u{i}",
+                    "role_associations": [{"role": role, "attributes": []}],
+                    "hierarchical_scopes": [],
+                }},
+            )
+
+        # 512 % clients == 0, so each closed-loop slot walks a disjoint
+        # residue class — no two threads ever share a Request object
+        corpus = [make_request(i) for i in range(512)]
+
+        def closed_loop_for(seconds, use_deadline=True):
+            stop = _threading.Event()
+            done_lock = _threading.Lock()
+            lats: list[float] = []
+            codes: list[int] = []
+
+            def loop(slot):
+                i, my_l, my_c = slot, [], []
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    resp = worker.service.is_allowed(
+                        corpus[i % len(corpus)],
+                        deadline=(t0 + deadline_ms / 1e3
+                                  if use_deadline else None),
+                    )
+                    my_l.append((time.monotonic() - t0) * 1e3)
+                    my_c.append(resp.operation_status.code)
+                    i += clients
+                with done_lock:
+                    lats.extend(my_l)
+                    codes.extend(my_c)
+
+            threads = [_threading.Thread(target=loop, args=(s,))
+                       for s in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join()
+            return lats, codes, time.perf_counter() - t0
+
+        # discarded warmup, DEADLINE-LESS (bench_overload's calibration
+        # discipline): the first batch shapes pay multi-second XLA
+        # compiles that dwarf any sane deadline — rejecting them would
+        # poison the admission EWMA with zero admitted evaluations to
+        # ever correct it.  Warm in windows until one runs STEADY (its
+        # p99 clears the deadline floor), bounded by SHADOW_WARMUP_MAX_S.
+        warm_until = time.monotonic() + warmup_max_s
+        wp99 = None
+        while time.monotonic() < warm_until:
+            warm, _, _ = closed_loop_for(warmup_s, use_deadline=False)
+            warm.sort()
+            if warm:
+                wp99 = warm[int(len(warm) * 0.99)]
+                if wp99 <= 250.0:
+                    break
+        if not deadline_env and wp99 is not None:
+            # 3x the steady-state warmup p99, floored at the explicit-knob
+            # default: tight enough that the bound means something, loose
+            # enough that admission admits
+            deadline_ms = max(250.0, 3.0 * wp99)
+        lats, codes, elapsed = closed_loop_for(duration_s)
+        shadow.drain(timeout_s=30.0)
+        status = shadow.status()
+
+        admitted = sorted(
+            lat for lat, code in zip(lats, codes) if code == 200
+        )
+        p50 = admitted[len(admitted) // 2] if admitted else None
+        p99 = admitted[int(len(admitted) * 0.99)] if admitted else None
+        return _result(
+            f"isAllowed admitted decisions/sec with live shadow diffing "
+            f"({n_rules}-rule tree)",
+            len(admitted) / elapsed,
+            "decisions/s",
+            {
+                "rules": n_rules,
+                "clients": clients,
+                "served": len(lats),
+                "admitted": len(admitted),
+                "shed_fraction": round(
+                    1.0 - len(admitted) / max(1, len(lats)), 4
+                ),
+                "admitted_p50_ms": round(p50, 3) if p50 else None,
+                "admitted_p99_ms": round(p99, 3) if p99 else None,
+                "deadline_ms": round(deadline_ms, 1),
+                "deadline_auto_sized": not bool(deadline_env),
+                "p99_within_deadline": bool(p99 is not None
+                                            and p99 <= deadline_ms),
+                "candidate_flip_every": flip_every,
+                "shadow_evaluated": status["evaluated"],
+                "shadow_diffs": status["diffs"],
+                "diffs_by_transition": status["diffs_by_transition"],
+                "diffs_found": bool(status["diffs"] > 0),
+                "shadow_dropped": status["dropped"],
+                "shadow_errors": status["errors"],
+                "new_program_keys": status["new_program_keys"],
+                "shadow_epoch": status["epoch"],
+                "bar": "shadow shares every production device program "
+                       "(new_program_keys empty), flipped-rule decisions "
+                       "surface as diffs, admitted p99 within the "
+                       "deadline bound — overload drops shadow work "
+                       "(counted), never a production decision",
+            },
+        )
+    finally:
+        worker.stop()
+
+
 HOST_ONLY = {"scalar", "wia", "overload", "cluster-scale", "tenant-scale"}
 
 # ROADMAP carry-over: the evidence rows stamped [cpu-fallback] while the
@@ -2550,7 +2868,7 @@ HOST_ONLY = {"scalar", "wia", "overload", "cluster-scale", "tenant-scale"}
 REFRESH_ONCHIP = [
     "stress-hr", "token-mix", "adapter-mixed", "crud-churn", "serve",
     "serve-latency", "wire-profile", "wire-pipeline", "overload",
-    "cluster-scale", "shard-scale",
+    "cluster-scale", "shard-scale", "explain-overhead", "shadow-diff",
 ]
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -2563,7 +2881,8 @@ def main():
                              "adapter-mixed", "adapter-mixed-warm",
                              "crud-churn", "shard-scale", "overload",
                              "degraded-mode", "cluster-scale",
-                             "tenant-scale"]
+                             "tenant-scale", "explain-overhead",
+                             "shadow-diff"]
     if "refresh-onchip" in which:
         # expand the runlist in place (dedup keeps explicit extras)
         expanded = []
@@ -2658,6 +2977,8 @@ def main():
         "degraded-mode": bench_degraded_mode,
         "cluster-scale": bench_cluster_scale,
         "tenant-scale": bench_tenant_scale,
+        "explain-overhead": bench_explain_overhead,
+        "shadow-diff": bench_shadow_diff,
     }
     for name in which:
         row = fns[name]()

@@ -1144,6 +1144,124 @@ def main() -> int:
                  "drift on same-class siblings (docs/MULTITENANT.md)"),
     })
 
+    # ---- explain-shadow-program-identity: explain mode OFF must lower
+    # the BYTE-identical device program as the pre-explain kernel (the
+    # hand-rolled runner below is the pre-explain source, verbatim), the
+    # explain variant keys SEPARATELY in the shared registry with one
+    # extra output and never perturbs the off-key executable, and a
+    # shadow evaluator over a same-size-class candidate tree reuses the
+    # production programs with ZERO new XLA compilations.
+    from access_control_srv_tpu.ops.kernel import tree_needs_hr
+    from access_control_srv_tpu.srv.shadow import ShadowEvaluator
+
+    exp_fixture = os.path.join(REPO, "tests", "fixtures", "role_scopes.yml")
+    engine_x = AccessController()
+    populate(engine_x, exp_fixture)
+    compiled_x = compile_policies(engine_x.policy_sets, engine_x.urns)
+    assert compiled_x.supported
+    reqs_x = grid_requests(n=12, seed=41)
+    batch_x = encode_requests(reqs_x, compiled_x)
+    with_hr_x = tree_needs_hr(compiled_x.arrays)
+    reg_x: dict = {}
+    kern_off = DecisionKernel(compiled_x, dynamic_policies=True,
+                              shared_jits=reg_x, explain=False)
+    kern_off.evaluate(batch_x)
+    off_key = ("dense", False, with_hr_x)
+    _, bk_x, ebk_x, padl_x = _lead_padding(batch_x)
+    largs_x = (
+        kern_off._c,
+        {k: jnp.asarray(padl_x(v)) for k, v in batch_x.arrays.items()},
+        jnp.asarray(_pad_cols(batch_x.rgx_set, ebk_x)),
+        jnp.asarray(_pad_cols(batch_x.pfx_neq, ebk_x)),
+        jnp.asarray(_pad_cols(batch_x.cond_true, bk_x)),
+        jnp.asarray(_pad_cols(batch_x.cond_abort, bk_x)),
+        jnp.asarray(_pad_cols(batch_x.cond_code, bk_x)),
+    )
+    hlo_off = reg_x[off_key].lower(*largs_x).as_text()
+
+    # the dense runner as it existed BEFORE explain mode: same vmap
+    # structure, _evaluate_one called WITHOUT the explain argument (the
+    # function is named `run` so even the HLO module name matches)
+    def run(c, ba, rs, pn, ct, ca, cc):
+        in_axes = ({k: 0 for k in ba}, None, None, 0, 0, 0)
+
+        def one(ra, rs_, pn_, ct_, ca_, cc_):
+            from access_control_srv_tpu.ops.kernel import _evaluate_one
+
+            rr = {**ra, "rgx_set": rs_, "pfx_neq": pn_,
+                  "cond_true": ct_, "cond_abort": ca_, "cond_code": cc_}
+            return _evaluate_one(c, rr, False, with_hr_x)
+
+        return jax.vmap(one, in_axes=in_axes)(ba, rs, pn, ct.T, ca.T, cc.T)
+
+    hlo_pre = jax.jit(run).lower(*largs_x).as_text()
+    del run
+    off_sizes_before = {
+        repr(k): f._cache_size() for k, f in reg_x.items()
+    }
+    kern_on = DecisionKernel(compiled_x, dynamic_policies=True,
+                             shared_jits=reg_x, explain=True)
+    out_on = kern_on.evaluate(batch_x)
+    on_key = off_key + ("explain",)
+    hlo_on = reg_x[on_key].lower(*largs_x).as_text()
+    off_sizes_after = {
+        repr(k): f._cache_size() for k, f in reg_x.items()
+        if repr(k) in off_sizes_before
+    }
+
+    # shadow half: production evaluator (delta path, shared registry),
+    # candidate = the same tree in the same size class
+    prod_x = HybridEvaluator(engine_x)
+    prod_x.is_allowed_batch(reqs_x)  # warm every program for this shape
+    shadow_keys_before = set(prod_x._shared_jits)
+    shadow_sizes_before = {
+        repr(k): f._cache_size() for k, f in prod_x._shared_jits.items()
+    }
+    shadow_x = ShadowEvaluator(prod_x, [exp_fixture])
+    shadow_served = shadow_x.evaluator.is_allowed_batch(reqs_x)
+    shadow_sizes_after = {
+        repr(k): f._cache_size() for k, f in prod_x._shared_jits.items()
+        if repr(k) in shadow_sizes_before
+    }
+    shadow_zero_compiles = (
+        shadow_x.new_program_keys == []
+        and set(prod_x._shared_jits) == shadow_keys_before
+        and shadow_sizes_after == shadow_sizes_before
+    )
+    shadow_caps_equal = (
+        prod_x._caps is not None
+        and shadow_x.evaluator._caps.as_dict() == prod_x._caps.as_dict()
+    )
+    shadow_x.stop()
+    prod_x.shutdown()
+    explain_shadow_ok = (
+        hlo_off == hlo_pre               # off path IS the pre-explain program
+        and len(out_on) == 4
+        and hlo_on != hlo_off            # explain variant is its own program
+        and off_sizes_after == off_sizes_before
+        and len(shadow_served) == len(reqs_x)
+        and shadow_zero_compiles
+        and shadow_caps_equal
+    )
+    results.append({
+        "kernel": "explain-shadow-program-identity",
+        "ok": bool(explain_shadow_ok),
+        "explain_off_identical_to_pre_explain": hlo_off == hlo_pre,
+        "explain_key_separate": bool(
+            on_key in reg_x and hlo_on != hlo_off
+        ),
+        "off_jit_cache_stable": off_sizes_after == off_sizes_before,
+        "shadow_new_program_keys": list(shadow_x.new_program_keys),
+        "shadow_jit_cache_stable": shadow_sizes_after == shadow_sizes_before,
+        "shadow_caps_equal": bool(shadow_caps_equal),
+        "note": ("explain OFF lowers the BYTE-identical device program as "
+                 "the pre-explain dense runner; explain ON registers under "
+                 "its own shared-jit key (one extra int32 output) without "
+                 "touching the off-key executable; a same-size-class "
+                 "shadow candidate reuses every production program — zero "
+                 "new XLA compilations, identical capacity class"),
+    })
+
     # ---- static-invariants-clean: acs-lint gate over the shipped tree.
     # The audit's host-only rows (tracing/admission-zero-device-ops)
     # prove specific modules import no device runtime; this row proves
