@@ -55,6 +55,7 @@ from .hierarchical_scope import (
     regex_entity_compare,
     split_entity_urn,
 )
+from .relation_path import check_target_relations
 from .verify_acl import verify_acl_list
 
 DEFAULT_COMBINING_ALGORITHMS = [
@@ -110,6 +111,10 @@ class AccessController:
         self.identity_client = identity_client
         self.hr_scope_provider = hr_scope_provider
         self.resource_adapter = resource_adapter
+        # Zanzibar-style tuple store (srv/relations.RelationTupleStore);
+        # None means no ReBAC workload — relation-bearing targets then
+        # fail closed, matching the kernel's empty-table planes.
+        self.relation_store = None
 
         self.combining_algorithms: dict[str, Callable] = {}
         for ca in combining_algorithms or DEFAULT_COMBINING_ALGORITHMS:
@@ -123,6 +128,12 @@ class AccessController:
 
     def clear_policies(self) -> None:
         self.policy_sets = {}
+
+    def _relation_graph(self):
+        """The live tuple graph, or None (fail-closed) when no store is
+        attached."""
+        store = self.relation_store
+        return store.graph if store is not None else None
 
     def replace_policy_sets(self, policy_sets: dict[str, "PolicySet"]) -> None:
         """Swap the whole tree atomically (single reference assignment):
@@ -264,6 +275,9 @@ class AccessController:
                         if policy.target and policy.target.subjects:
                             policy_subject_match = check_hierarchical_scope(
                                 policy.target, request, self.urns, self, self.logger
+                            ) and check_target_relations(
+                                policy.target, request,
+                                self._relation_graph(), self.urns,
                             )
                         else:
                             policy_subject_match = True
@@ -316,6 +330,11 @@ class AccessController:
                                             self.urns,
                                             self,
                                             self.logger,
+                                        ) and check_target_relations(
+                                            rule.target,
+                                            request,
+                                            self._relation_graph(),
+                                            self.urns,
                                         )
                                     try:
                                         if matches and rule.condition:
@@ -621,6 +640,15 @@ class AccessController:
         (reference: accessController.ts:793-823)."""
         context = request.context
         role_urn = self.urns.get("role")
+        relation_urn = self.urns.get("relation")
+        # relation-path attributes are matched by the tuple-store gate
+        # (check_target_relations), never by id+value equality against the
+        # request — a target whose subjects are ALL relation paths is
+        # user-unconstrained here
+        rule_sub_attributes = [
+            a for a in (rule_sub_attributes or [])
+            if a is None or a.id != relation_urn
+        ]
         if not rule_sub_attributes or len(rule_sub_attributes) == 0:
             return True
         rule_role = None

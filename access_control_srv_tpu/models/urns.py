@@ -34,6 +34,7 @@ DEFAULT_URNS: dict[str, str] = {
     "modify": "urn:restorecommerce:acs:names:action:modify",
     "delete": "urn:restorecommerce:acs:names:action:delete",
     "organization": "urn:restorecommerce:acs:model:organization.Organization",
+    "relation": "urn:restorecommerce:acs:names:relation",
     "aclIndicatoryEntity": "urn:restorecommerce:acs:names:aclIndicatoryEntity",
     "aclInstance": "urn:restorecommerce:acs:names:aclInstance",
     "skipACL": "urn:restorecommerce:acs:names:skipACL",

@@ -38,7 +38,8 @@ _build_error: Optional[str] = None
 _ARRAY_ORDER = [
     "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
     "r_ent_vals", "r_ent_e", "r_ent_valid",
-    "r_inst_run", "r_inst_valid", "r_inst_present", "r_inst_has_owners",
+    "r_inst_run", "r_inst_id", "r_inst_valid", "r_inst_present",
+    "r_inst_has_owners",
     "r_inst_owner_ent", "r_inst_owner_inst",
     "r_prop_vals", "r_prop_sfx", "r_prop_run", "r_prop_tail",
     "r_op_vals", "r_op_present", "r_op_has_owners",
@@ -137,6 +138,20 @@ def _load():
             + [ctypes.c_int32] * 2
             + [ctypes.c_void_p, ctypes.c_void_p]
         )
+        lib.acs_enc_intern.restype = ctypes.c_int32
+        lib.acs_enc_intern.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.acs_pack_relation_bits.restype = None
+        # raw buffer pointers + dims; see host_encoder.cpp for the order
+        lib.acs_pack_relation_bits.argtypes = (
+            [ctypes.c_void_p] * 5
+            + [ctypes.c_int32] * 3
+            + [ctypes.c_void_p] * 3
+            + [ctypes.c_int64]
+            + [ctypes.c_int32] * 2
+            + [ctypes.c_void_p, ctypes.c_void_p]
+        )
         _lib = lib
         return _lib
 
@@ -224,6 +239,15 @@ class NativeBatchEncoder:
             )
         else:
             self._hrv_role = self._hrv_scope = None
+        # ReBAC relation planes (ops/relation.py): with relation-bearing
+        # targets the packed closure bitplanes are computed natively too
+        # (acs_pack_relation_bits, bit-identical to
+        # ops/relation.pack_relation_bitplanes — fuzz-checked); the flat
+        # verdict tables arrive per batch via encode_wire, translated
+        # into THIS encoder's id space (native_relation_tables)
+        from ..ops.relation import relation_bits_needed
+
+        self._needs_rel = relation_bits_needed(compiled)
         # pooled staging (ops/staging.py): with ``reuse=True`` the row
         # arrays, masks, regex matrices and owner-bit buffers all recycle
         # through arenas keyed by their (shape, caps) bucket — a warm
@@ -329,9 +353,79 @@ class NativeBatchEncoder:
         )
         return {"r_own_runs": out_runs, "r_own_bits": out_bits}
 
+    @property
+    def needs_relation_bits(self) -> bool:
+        return self._needs_rel
+
+    def _intern(self, s: str) -> int:
+        """Intern in the C++ id space; caller holds ``_call_lock``."""
+        raw = s.encode()
+        return int(self.lib.acs_enc_intern(self._handle, raw, len(raw)))
+
+    def native_relation_tables(self, store):
+        """The store's flat verdict tables translated into this encoder's
+        id space (srv/relations.tables_for(space="native")) — strings
+        interned after the preload snapshot get DIFFERENT ids in the
+        Python and C++ interners, so each space builds (and caches) its
+        own table.  None for relation-free trees."""
+        if not self._needs_rel:
+            return None
+        with self._call_lock:
+            return store.tables_for(
+                self.compiled, intern=self._intern, space="native"
+            )
+
+    def relation_bits_native(self, a: dict, B: int, tables=None,
+                             take=None) -> dict:
+        """Packed relation closure bitplanes via the C++ packer — the
+        native replacement for ops/relation.pack_relation_bitplanes over
+        the same raw row arrays (bit-identical; fuzz-checked).  A missing
+        table behaves as an empty tuple set (fail-closed), matching the
+        Python packer and the scalar oracle."""
+        from ..ops.encode import owner_bit_layout
+        from ..ops.interner import ABSENT as _ABS
+        from ..ops.relation import empty_relation_tables
+
+        if take is None:
+            take = np.empty
+        if not self._needs_rel:
+            out_runs = take((B, 1), np.int32)
+            out_bits = take((B, 1), np.int32)
+            out_runs.fill(_ABS)
+            out_bits.fill(0)
+            return {"r_rel_runs": out_runs, "r_rel_bits": out_bits}
+        relv = int(np.asarray(self.compiled.arrays["relv_path"]).shape[0])
+        if tables is None:
+            tables = empty_relation_tables(relv)
+        NI = a["r_inst_run"].shape[1]
+        NR = a["r_ent_vals"].shape[1]
+        max_runs = self.lib.acs_own_max_runs(
+            a["r_inst_run"].ctypes.data, a["r_inst_valid"].ctypes.data,
+            B, NI,
+        )
+        nru = _pyenc._pow2_at_least(int(max_runs) if B else 1, 1)
+        _, _, _, nwords = owner_bit_layout(relv, nru, 0)
+        out_runs = take((B, nru), np.int32)
+        out_bits = take((B, nwords), np.int32)
+        obj_offs = np.ascontiguousarray(tables["obj_offs"], np.int64)
+        obj_keys = np.ascontiguousarray(tables["obj_keys"], np.int64)
+        pairs = np.ascontiguousarray(tables["pairs"], np.int64)
+        self.lib.acs_pack_relation_bits(
+            a["r_inst_run"].ctypes.data, a["r_inst_valid"].ctypes.data,
+            a["r_ent_vals"].ctypes.data, a["r_inst_id"].ctypes.data,
+            a["r_subject_id"].ctypes.data,
+            B, NR, NI,
+            obj_offs.ctypes.data, obj_keys.ctypes.data, pairs.ctypes.data,
+            int(pairs.shape[0]),
+            relv, nru,
+            out_runs.ctypes.data, out_bits.ctypes.data,
+        )
+        return {"r_rel_runs": out_runs, "r_rel_bits": out_bits}
+
     def encode_wire(self, messages: list[bytes],
                     caps: dict[str, int] | None = None,
-                    reuse: bool = False) -> RequestBatch:
+                    reuse: bool = False,
+                    relation_tables: dict | None = None) -> RequestBatch:
         """Encode serialized acstpu.Request messages.
 
         ``caps`` overrides the per-request padding shapes (the floor
@@ -433,6 +527,11 @@ class NativeBatchEncoder:
         arrays = dict(a)  # the arena keeps its canonical row-array dict
         arrays.update(self.owner_bits_native(
             a, B, take=take if reuse else None
+        ))
+        # relation closure bitplanes (dummies for relation-free trees;
+        # fail-closed empties when no store table was supplied)
+        arrays.update(self.relation_bits_native(
+            a, B, tables=relation_tables, take=take if reuse else None
         ))
 
         release = None

@@ -18,6 +18,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -std=c++17 host_encoder.cpp -o libacs_host.so
 
+#include <algorithm>
 #include <array>
 #include <cstdint>
 #include <cstring>
@@ -452,6 +453,7 @@ struct OutArrays {
   int32_t* r_ent_e;          // [B, NR]
   uint8_t* r_ent_valid;      // [B, NR]
   int32_t* r_inst_run;       // [B, NI]
+  int32_t* r_inst_id;        // [B, NI] interned instance-id strings
   uint8_t* r_inst_valid;     // [B, NI]
   uint8_t* r_inst_present;   // [B, NI]
   uint8_t* r_inst_has_owners;// [B, NI]
@@ -670,6 +672,7 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
   o.r_ent_e = (int32_t*)ptrs[pi++];
   o.r_ent_valid = (uint8_t*)ptrs[pi++];
   o.r_inst_run = (int32_t*)ptrs[pi++];
+  o.r_inst_id = (int32_t*)ptrs[pi++];
   o.r_inst_valid = (uint8_t*)ptrs[pi++];
   o.r_inst_present = (uint8_t*)ptrs[pi++];
   o.r_inst_has_owners = (uint8_t*)ptrs[pi++];
@@ -981,6 +984,9 @@ int32_t acs_enc_batch(void* h, const uint8_t* buf, const int64_t* offs,
       for (std::string_view inst : runs[j].instances) {
         const JValue* ctx_res = find_ctx_resource(ctx_resources, inst);
         o.r_inst_run[b * NI + inst_slot] = (int32_t)j;
+        // interned HERE, matching the Python encoder's fill order (the
+        // relation-bit packer keys closure lookups on this id)
+        o.r_inst_id[b * NI + inst_slot] = enc.interner.intern(inst);
         o.r_inst_valid[b * NI + inst_slot] = 1;
         if (ctx_res != nullptr) {
           o.r_inst_present[b * NI + inst_slot] = 1;
@@ -1268,6 +1274,116 @@ void acs_pack_owner_bits(
       } else {
         for (int k = 0; k < ebits; ++k)
           if (bits[k]) b_words[e * wpe + k / 32] |= 1u << (k % 32);
+      }
+    }
+  }
+}
+
+// intern (or look up) one string in THIS encoder's id space.  The
+// serving store translates its relation verdict tables into native ids
+// with this (srv/relations.tables_for(space="native")) — strings interned
+// after the preload snapshot diverge between the Python and C++ spaces,
+// so each id space gets its own table build.  Caller holds the
+// per-encoder call lock (the interner is not thread-safe).
+int32_t acs_enc_intern(void* h, const char* bytes, int32_t len) {
+  return ((Encoder*)h)->interner.intern(
+      std::string_view(bytes, (size_t)len));
+}
+
+// ------------------------------------------------- relation-bit packing
+// Native transcription of ops/relation.pack_relation_bitplanes: per
+// (row, relation-vocab entry) the reachable-subject verdicts of the
+// targeted instances fold into packed A/B fail bits laid out by
+// ops/encode.owner_bit_layout(RELV, nru, 0) (ebits = 2*nru; bit g =
+// full-closure plane fails for run g, bit nru+g = literal-tuples plane
+// fails).  Membership comes from the store's flat verdict tables
+// (translated into this encoder's id space): segment [obj_offs[v*2+p],
+// obj_offs[v*2+p+1]) of sorted (ent<<32)|inst object keys, plus one
+// globally sorted (object_row<<32)|subject pairs array — two binary
+// searches per (instance, vocab, plane).  Bit-identity with the Python
+// packer is enforced by tests/test_native_encoder.py's fuzz comparison.
+void acs_pack_relation_bits(
+    const int32_t* inst_run, const uint8_t* inst_valid,
+    const int32_t* ent_vals, const int32_t* inst_id,
+    const int32_t* subject_id,
+    int32_t B, int32_t NR, int32_t NI,
+    const int64_t* obj_offs, const int64_t* obj_keys,
+    const int64_t* pairs, int64_t n_pairs,
+    int32_t RELV, int32_t nru,
+    int32_t* rel_runs_out, uint32_t* bits_out) {
+  const int ebits = 2 * nru;
+  int epw = 0, wpe = 1, nwords;
+  if (ebits <= 32) {
+    epw = 32 / ebits;
+    nwords = (RELV + epw - 1) / epw;
+  } else {
+    wpe = (ebits + 31) / 32;
+    nwords = RELV * wpe;
+  }
+  // verdict for one (vocab, plane) segment: object-key search, then the
+  // (GLOBAL object row, subject) pair search — mirrors _plane_pass
+  auto plane_pass = [&](int32_t idx, int64_t key, int64_t subj) -> bool {
+    int64_t lo = obj_offs[idx], hi = obj_offs[idx + 1];
+    if (hi <= lo || n_pairs == 0) return false;
+    const int64_t* it = std::lower_bound(obj_keys + lo, obj_keys + hi, key);
+    if (it == obj_keys + hi || *it != key) return false;
+    int64_t pk = ((int64_t)(it - obj_keys) << 32) | subj;
+    const int64_t* pit = std::lower_bound(pairs, pairs + n_pairs, pk);
+    return pit != pairs + n_pairs && *pit == pk;
+  };
+  std::vector<int32_t> runs;         // distinct valid runs, ascending
+  std::vector<uint8_t> bits(ebits);  // per-entry fail bits, k-indexed
+  for (int32_t b = 0; b < B; ++b) {
+    const int32_t* b_inst_run = inst_run + (int64_t)b * NI;
+    const uint8_t* b_inst_valid = inst_valid + (int64_t)b * NI;
+    uint32_t* b_words = bits_out + (int64_t)b * nwords;
+    for (int w = 0; w < nwords; ++w) b_words[w] = 0;
+    int32_t* b_runs = rel_runs_out + (int64_t)b * nru;
+    for (int g = 0; g < nru; ++g) b_runs[g] = ABSENT;
+
+    runs.clear();
+    for (int32_t i = 0; i < NI; ++i) {
+      if (!b_inst_valid[i]) continue;
+      int32_t run = b_inst_run[i];
+      if (run < 0) continue;
+      auto it = runs.begin();
+      while (it != runs.end() && *it < run) ++it;
+      if (it == runs.end() || *it != run) runs.insert(it, run);
+    }
+    for (size_t g = 0; g < runs.size() && (int)g < nru; ++g)
+      b_runs[g] = runs[g];
+
+    const bool subj_ok = subject_id[b] >= 0;
+    const int64_t subj_pk = subj_ok ? (int64_t)subject_id[b] : 0;
+    for (int32_t v = 0; v < RELV; ++v) {
+      for (int k = 0; k < ebits; ++k) bits[k] = 0;
+      for (int32_t i = 0; i < NI; ++i) {
+        // valid_i in the Python packer: r_inst_valid & (inst_run >= 0)
+        if (!b_inst_valid[i] || b_inst_run[i] < 0) continue;
+        int32_t run = b_inst_run[i];
+        int32_t ent = ent_vals[(int64_t)b * NR + run];
+        int32_t inst = inst_id[(int64_t)b * NI + i];
+        bool key_ok = ent >= 0 && inst >= 0 && subj_ok;
+        int64_t key = ((int64_t)(ent < 0 ? 0 : ent) << 32)
+                      | (int64_t)(inst < 0 ? 0 : inst);
+        bool ok_f = key_ok && plane_pass(v * 2, key, subj_pk);
+        bool ok_d = key_ok && plane_pass(v * 2 + 1, key, subj_pk);
+        if (ok_f && ok_d) continue;
+        for (int g = 0; g < nru; ++g) {
+          if (b_runs[g] != run) continue;
+          if (!ok_f) bits[g] = 1;
+          if (!ok_d) bits[nru + g] = 1;
+        }
+      }
+      // pack entry v's bits per owner_bit_layout(RELV, nru, 0)
+      if (epw) {
+        uint32_t* word = b_words + v / epw;
+        int base = (v % epw) * ebits;
+        for (int k = 0; k < ebits; ++k)
+          if (bits[k]) *word |= 1u << (base + k);
+      } else {
+        for (int k = 0; k < ebits; ++k)
+          if (bits[k]) b_words[v * wpe + k / 32] |= 1u << (k % 32);
       }
     }
   }

@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..core.relation_path import parse_path
 from ..models.model import PolicySet, Target
 from ..models.urns import Urns
 from .interner import ABSENT, StringInterner
@@ -75,6 +76,10 @@ class CompiledPolicies:
     conditions: list[CompiledCondition]
     entity_vocab: list[str]          # distinct target entity values (regex rows)
     entity_vocab_ids: dict[int, int]  # interned value id -> vocab row
+    # distinct relation-path expressions on target subjects (the ReBAC
+    # bitplane vocabulary, ops/relation.py); host-only like entity_vocab
+    rel_vocab: list[str] = field(default_factory=list)
+    rel_vocab_ids: dict[int, int] = field(default_factory=dict)
     supported: bool = True
     unsupported_reason: str = ""
     S: int = 0
@@ -94,6 +99,11 @@ class CompiledPolicies:
     @property
     def has_hr_targets(self) -> bool:
         return bool(self.arrays["t_has_scoping"].any())
+
+    @property
+    def has_rel_targets(self) -> bool:
+        t = self.arrays.get("t_rel_idx")
+        return t is not None and bool((np.asarray(t) >= 0).any())
 
 
 def _pad(values: list[int], width: int) -> list[int]:
@@ -123,6 +133,13 @@ TARGET_COLUMNS: list[tuple[str, str, type]] = [
     ("t_prop_sfx", "prop_sfx", np.int32),
     ("t_has_props", "has_props", bool),
     ("t_n_res", "n_res", np.int32),
+    # relation-path requirement (ReBAC, docs/REBAC.md): the interned path
+    # expression (host-only routing, never shipped to device), its
+    # relation-vocab row (gathers into the packed r_rel_bits planes) and
+    # the !direct flag selecting the literal-tuples-only plane
+    ("t_rel_path", "rel_path", np.int32),
+    ("t_rel_idx", "rel_idx", np.int32),
+    ("t_rel_direct", "rel_direct", bool),
 ]
 
 
@@ -131,11 +148,15 @@ def lower_target(
     interner: StringInterner,
     urns: Urns,
     vocab_row,
+    rel_row=None,
 ) -> tuple[dict, Optional[str]]:
     """Lower ONE target into its row dict (the closed-form per-row
     representation the kernel gathers from).  ``vocab_row(value) -> int``
     allocates/looks up the entity regex-vocab row — the fresh compiler
     appends, the delta patcher allocates inside a fixed capacity.
+    ``rel_row(path) -> int`` does the same for the relation-path vocab
+    (ops/relation.py bitplanes); None marks relation-bearing targets
+    unsupported.
 
     Returns (row, unsupported_reason_or_None); shared by the from-scratch
     compile below and the in-place set relowering in ops/delta.py so the
@@ -152,13 +173,22 @@ def lower_target(
     entity_urn = urns.get("entity")
     property_urn = urns.get("property")
     operation_urn = urns.get("operation")
+    relation_urn = urns.get("relation")
 
     role = None
     scoping = None
     hr_check = "true"
     skip_acl = False
     sub_pairs = []
+    rel_paths: list[str] = []
     for a in t.subjects or []:
+        if a.id == relation_urn:
+            # relation requirements gate through the packed bitplanes
+            # (stage B analog), not the subject pair-subset match — the
+            # scalar oracle filters them identically
+            # (core/engine._check_subject_matches)
+            rel_paths.append(a.value or "")
+            continue
         sub_pairs.append((it(a.id), it(a.value)))
         if a.id == role_urn:
             role = a.value
@@ -196,6 +226,17 @@ def lower_target(
         # per-attribute state the closed form cannot represent
         unsupported = "target mixes multiple entities with properties"
 
+    rel_parsed = None
+    if len(rel_paths) > 1:
+        unsupported = "multiple relation attributes on one target"
+    elif rel_paths:
+        try:
+            rel_parsed = parse_path(rel_paths[0])
+        except ValueError:
+            unsupported = f"invalid relation path {rel_paths[0]!r}"
+        if rel_parsed is not None and rel_row is None:
+            unsupported = "relation path without a relation vocab"
+
     ent_ids = [it(v) for v in ent_vals]
     row["n_subjects"] = len(t.subjects or [])
     row["role"] = it(role) if role is not None else ABSENT
@@ -217,6 +258,14 @@ def lower_target(
     row["prop_sfx"] = _pad([interner.suffix_id[i] for i in prop_ids], K_PROP)
     row["has_props"] = len(prop_vals) > 0
     row["n_res"] = len(t.resources or [])
+    if rel_parsed is not None and rel_row is not None and unsupported is None:
+        row["rel_path"] = it(rel_paths[0])
+        row["rel_idx"] = rel_row(rel_paths[0])
+        row["rel_direct"] = rel_parsed.direct
+    else:
+        row["rel_path"] = ABSENT
+        row["rel_idx"] = ABSENT
+        row["rel_direct"] = False
     return row, unsupported
 
 
@@ -227,6 +276,8 @@ class _TargetTable:
         self.rows: list[dict] = []
         self.entity_vocab: list[str] = []
         self.entity_vocab_ids: dict[int, int] = {}
+        self.rel_vocab: list[str] = []
+        self.rel_vocab_ids: dict[int, int] = {}
         self.unsupported: Optional[str] = None
         self.owners: dict[tuple, int] = {}
 
@@ -239,10 +290,19 @@ class _TargetTable:
             self.entity_vocab_ids[vid] = row
         return row
 
+    def _rel_row(self, value: str) -> int:
+        vid = self.interner.intern(value)
+        row = self.rel_vocab_ids.get(vid)
+        if row is None:
+            row = len(self.rel_vocab)
+            self.rel_vocab.append(value)
+            self.rel_vocab_ids[vid] = row
+        return row
+
     def add(self, target: Optional[Target], owner: Optional[tuple] = None) -> int:
         """Lower a target into a row; returns the row index."""
         row, unsupported = lower_target(
-            target, self.interner, self.urns, self._vocab_row
+            target, self.interner, self.urns, self._vocab_row, self._rel_row
         )
         if unsupported:
             self.unsupported = unsupported
@@ -439,6 +499,13 @@ def compile_policies(
     arrays["t_rs_idx"] = t_rs.reshape(-1).astype(np.int32)
     arrays["hrv_role"] = np.ascontiguousarray(rs_vocab[:, 0], np.int32)
     arrays["hrv_scope"] = np.ascontiguousarray(rs_vocab[:, 1], np.int32)
+    # relation-path vocabulary (interned expressions, host-only like
+    # hrv_*): t_rel_idx rows gather the packed r_rel_bits planes by this
+    # order; the serving store builds its verdict tables in the same order
+    # (srv/relations.RelationTupleStore.tables_for)
+    arrays["relv_path"] = np.array(
+        [interner.intern(v) for v in table.rel_vocab], np.int32
+    )
     # interned URN ids the ACL kernel stage compares against (reference:
     # verifyACL.ts:37-44, 138-150): [role attr id, user entity, actionID
     # attr id, create, read, modify, delete]
@@ -462,6 +529,8 @@ def compile_policies(
         conditions=cond_sink.conditions,
         entity_vocab=table.entity_vocab,
         entity_vocab_ids=table.entity_vocab_ids,
+        rel_vocab=table.rel_vocab,
+        rel_vocab_ids=table.rel_vocab_ids,
         supported=unsupported is None,
         unsupported_reason=unsupported or "",
         S=S,

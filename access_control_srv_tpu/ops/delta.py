@@ -102,10 +102,15 @@ class Capacities:
     T: int   # target-table rows
     RV: int  # (role, scoping) vocab entries (owner-bitplane width driver)
     W: int   # entity regex-vocab rows (rgx_set leading dim)
+    # relation-path vocab entries (ReBAC bitplane width driver,
+    # ops/relation.py); appended with a default so pre-ReBAC callers and
+    # persisted size classes stay valid
+    RELV: int = 4
 
     def as_dict(self) -> dict:
         return {"S": self.S, "KP": self.KP, "KR": self.KR,
-                "T": self.T, "RV": self.RV, "W": self.W}
+                "T": self.T, "RV": self.RV, "W": self.W,
+                "RELV": self.RELV}
 
 
 def _bucket(n: int, headroom: float, floor: int) -> int:
@@ -127,6 +132,7 @@ def capacities_for(
         S=compiled.S, KP=compiled.KP, KR=compiled.KR, T=compiled.T,
         RV=int(np.asarray(compiled.arrays["hrv_role"]).shape[0]),
         W=max(len(compiled.entity_vocab), 1),
+        RELV=max(len(compiled.rel_vocab), 1),
     )
     fresh = Capacities(
         S=_bucket(live.S, headroom, 2),
@@ -135,9 +141,10 @@ def capacities_for(
         T=_bucket(live.T, headroom, 8),
         RV=_bucket(live.RV, headroom, 4),
         W=_bucket(live.W, headroom, 4),
+        RELV=_bucket(live.RELV, headroom, 4),
     )
     if prev is not None:
-        dims = ("S", "KP", "KR", "T", "RV", "W")
+        dims = ("S", "KP", "KR", "T", "RV", "W", "RELV")
         fits = all(getattr(prev, d) >= getattr(live, d) for d in dims)
         tight = all(
             getattr(prev, d) <= 2 * getattr(fresh, d) for d in dims
@@ -173,7 +180,11 @@ _T_FILLS = {"t_n_subjects": 0, "t_role": ABSENT, "t_has_role": False,
             "t_sub_vals": ABSENT, "t_act_ids": ABSENT, "t_act_vals": ABSENT,
             "t_ent_vals": ABSENT, "t_ent_w": ABSENT, "t_ent_tails": ABSENT,
             "t_op_vals": ABSENT, "t_prop_vals": ABSENT, "t_prop_sfx": ABSENT,
-            "t_has_props": False, "t_n_res": 0, "t_rs_idx": 0}
+            "t_has_props": False, "t_n_res": 0, "t_rs_idx": 0,
+            # ABSENT (not 0): pad rows must stay relation-trivial so the
+            # tree_needs_rel program selector never flips on padding
+            "t_rel_path": ABSENT, "t_rel_idx": ABSENT,
+            "t_rel_direct": False}
 
 
 def _pad_axis(arr: np.ndarray, axis: int, size: int, fill) -> np.ndarray:
@@ -208,6 +219,9 @@ def pad_compiled(compiled: CompiledPolicies, caps: Capacities
         a[name] = _pad_axis(a[name], 0, caps.T, fill)
     a["hrv_role"] = _pad_axis(a["hrv_role"], 0, caps.RV, ABSENT)
     a["hrv_scope"] = _pad_axis(a["hrv_scope"], 0, caps.RV, ABSENT)
+    # pad relation-vocab rows are ABSENT and unreferenced by any live
+    # t_rel_idx; the store's verdict tables carry empty segments for them
+    a["relv_path"] = _pad_axis(a["relv_path"], 0, caps.RELV, ABSENT)
 
     vocab = list(compiled.entity_vocab)
     while len(vocab) < caps.W:
@@ -227,6 +241,8 @@ def pad_compiled(compiled: CompiledPolicies, caps: Capacities
         conditions=conditions,
         entity_vocab=vocab,
         entity_vocab_ids=dict(compiled.entity_vocab_ids),
+        rel_vocab=list(compiled.rel_vocab),
+        rel_vocab_ids=dict(compiled.rel_vocab_ids),
         S=caps.S, KP=caps.KP, KR=caps.KR, T=caps.T,
         target_owners=dict(compiled.target_owners),
     )
@@ -539,7 +555,10 @@ class DeltaState:
     rule_refs: dict = field(default_factory=dict)  # rule id -> set ids
     pol_refs: dict = field(default_factory=dict)   # policy id -> set ids
     needs_hr: bool = False
+    needs_rel: bool = False
     prefilter_active: bool = False
+    relv_live: int = 0
+    rel_map: dict = field(default_factory=dict)    # interned path id -> row
 
     def clone(self) -> "DeltaState":
         return DeltaState(
@@ -561,7 +580,10 @@ class DeltaState:
             rule_refs={k: set(v) for k, v in self.rule_refs.items()},
             pol_refs={k: set(v) for k, v in self.pol_refs.items()},
             needs_hr=self.needs_hr,
+            needs_rel=self.needs_rel,
             prefilter_active=self.prefilter_active,
+            relv_live=self.relv_live,
+            rel_map=dict(self.rel_map),
         )
 
 
@@ -588,6 +610,12 @@ def _needs_hr(arrays: dict) -> bool:
         (np.asarray(arrays["t_has_scoping"])
          & (np.asarray(arrays["t_n_subjects"]) > 0)).any()
     )
+
+
+def _needs_rel(arrays: dict) -> bool:
+    # mirrors ops/kernel.tree_needs_rel without importing the jax module
+    t = arrays.get("t_rel_idx")
+    return t is not None and bool((np.asarray(t) >= 0).any())
 
 
 def _prefilter_threshold() -> int:
@@ -618,7 +646,10 @@ def build_state(
     }
     state.rule_refs, state.pol_refs = _tree_refs(tree)
     state.needs_hr = _needs_hr(padded.arrays)
+    state.needs_rel = _needs_rel(padded.arrays)
     state.prefilter_active = raw.n_rules >= _prefilter_threshold()
+    state.relv_live = len(raw.rel_vocab)
+    state.rel_map = dict(padded.rel_vocab_ids)
 
     sets = [ps for ps in tree.values() if ps is not None]
     for s, ps in enumerate(sets):
@@ -678,6 +709,7 @@ def live_capacities(compiled: CompiledPolicies) -> Capacities:
         S=compiled.S, KP=compiled.KP, KR=compiled.KR, T=compiled.T,
         RV=int(np.asarray(compiled.arrays["hrv_role"]).shape[0]),
         W=max(len(compiled.entity_vocab), 1),
+        RELV=max(len(compiled.rel_vocab), 1),
     )
 
 
@@ -697,7 +729,7 @@ def fixed_caps_compile(tree, urns: Urns, caps: Capacities,
     if not raw.supported:
         return raw, None, None
     live = live_capacities(raw)
-    for dim in ("S", "KP", "KR", "T", "RV", "W"):
+    for dim in ("S", "KP", "KR", "T", "RV", "W", "RELV"):
         if getattr(live, dim) > getattr(caps, dim):
             raise DeltaIneligible(f"capacity-class-{dim}")
     padded = pad_compiled(raw, caps)
@@ -716,7 +748,9 @@ class _DeltaTargetTable:
 
     def __init__(self, arrays: dict, state: DeltaState, set_state: SetState,
                  old_rows: dict, interner, urns: Urns,
-                 entity_vocab: list, entity_vocab_ids: dict):
+                 entity_vocab: list, entity_vocab_ids: dict,
+                 rel_vocab: Optional[list] = None,
+                 rel_vocab_ids: Optional[dict] = None):
         self.arrays = arrays
         self.state = state
         self.set_state = set_state
@@ -726,6 +760,8 @@ class _DeltaTargetTable:
         self.urns = urns
         self.entity_vocab = entity_vocab
         self.entity_vocab_ids = entity_vocab_ids
+        self.rel_vocab = rel_vocab if rel_vocab is not None else []
+        self.rel_vocab_ids = rel_vocab_ids if rel_vocab_ids is not None else {}
         self.unsupported: Optional[str] = None
         self.rows_written = 0
 
@@ -740,6 +776,23 @@ class _DeltaTargetTable:
             self.entity_vocab[row] = value
             self.entity_vocab_ids[vid] = row
             self.state.w_live += 1
+        return row
+
+    def _rel_row(self, value: str) -> int:
+        vid = self.interner.intern(value)
+        row = self.rel_vocab_ids.get(vid)
+        if row is None:
+            if self.state.relv_live >= self.state.caps.RELV:
+                raise DeltaIneligible("capacity-rel-vocab")
+            row = self.state.relv_live
+            if row < len(self.rel_vocab):
+                self.rel_vocab[row] = value
+            else:
+                self.rel_vocab.append(value)
+            self.rel_vocab_ids[vid] = row
+            self.arrays["relv_path"][row] = vid
+            self.state.rel_map[vid] = row
+            self.state.relv_live += 1
         return row
 
     def _rs_row(self, role: int, scope: int) -> int:
@@ -769,7 +822,7 @@ class _DeltaTargetTable:
 
     def add(self, target, owner: Optional[tuple] = None) -> int:
         row_dict, unsupported = lower_target(
-            target, self.interner, self.urns, self._vocab_row
+            target, self.interner, self.urns, self._vocab_row, self._rel_row
         )
         if unsupported:
             self.unsupported = unsupported
@@ -880,6 +933,8 @@ def apply_events(
     a = {k: np.array(v) for k, v in compiled.arrays.items()}
     vocab = list(compiled.entity_vocab)
     vocab_ids = dict(compiled.entity_vocab_ids)
+    rvocab = list(compiled.rel_vocab)
+    rvocab_ids = dict(compiled.rel_vocab_ids)
     conditions = list(compiled.conditions)
     owners = dict(compiled.target_owners)
     ns = state.clone()
@@ -910,7 +965,7 @@ def apply_events(
         ns.sets[sid] = new_set
         table = _DeltaTargetTable(
             a, ns, new_set, old_rows, compiled.interner, urns,
-            vocab, vocab_ids,
+            vocab, vocab_ids, rvocab, rvocab_ids,
         )
         cond_sink = _DeltaConditionSink(ns, new_set, old_conds, conditions)
         clear_set_slot(a, s)
@@ -939,6 +994,9 @@ def apply_events(
     # not change (with_hr selection, prefilter activation threshold)
     if _needs_hr(a) != state.needs_hr:
         raise DeltaIneligible("hr-topology-changed")
+    if _needs_rel(a) != state.needs_rel:
+        # with_rel selects a different program variant (tree_needs_rel)
+        raise DeltaIneligible("rel-topology-changed")
     n_rules = int(a["rule_valid"].sum())
     if (n_rules >= _prefilter_threshold()) != state.prefilter_active:
         raise DeltaIneligible("prefilter-threshold-crossed")
@@ -955,6 +1013,8 @@ def apply_events(
         conditions=conditions,
         entity_vocab=vocab,
         entity_vocab_ids=vocab_ids,
+        rel_vocab=rvocab,
+        rel_vocab_ids=rvocab_ids,
         target_owners=owners,
     )
     return "patch", new_compiled, ns, stats

@@ -325,6 +325,7 @@ def alloc_row_arrays(B: int, caps: dict[str, int] | None = None
         "r_ent_e": np.zeros((B, NR), np.int32),
         "r_ent_valid": np.zeros((B, NR), bool),
         "r_inst_run": np.full((B, NI), ABSENT, np.int32),
+        "r_inst_id": np.full((B, NI), ABSENT, np.int32),
         "r_inst_valid": np.zeros((B, NI), bool),
         "r_inst_present": np.zeros((B, NI), bool),
         "r_inst_has_owners": np.zeros((B, NI), bool),
@@ -369,7 +370,8 @@ def alloc_row_arrays(B: int, caps: dict[str, int] | None = None
 # buffer is indistinguishable from a fresh allocation
 _ABSENT_FILLED = frozenset({
     "r_sub_ids", "r_sub_vals", "r_roles", "r_act_ids", "r_act_vals",
-    "r_ent_vals", "r_inst_run", "r_inst_owner_ent", "r_inst_owner_inst",
+    "r_ent_vals", "r_inst_run", "r_inst_id",
+    "r_inst_owner_ent", "r_inst_owner_inst",
     "r_prop_vals", "r_prop_sfx", "r_prop_run", "r_prop_tail", "r_op_vals",
     "r_op_owner_ent", "r_op_owner_inst", "r_ra3", "r_ra2", "r_hr",
     "r_acl_ent", "r_acl_inst", "r_acl_hr", "r_hr_roles", "r_subject_id",
@@ -584,6 +586,8 @@ def encode_requests(
     skip_conditions: bool = False,
     caps: dict[str, int] | None = None,
     skip_owner_bits: bool = False,
+    relation_tables: Optional[dict] = None,
+    skip_relation_bits: bool = False,
 ) -> RequestBatch:
     """``skip_conditions=True`` skips the host-assisted condition pre-pass
     (and its adapter-driven batch degradation): whatIsAllowed never
@@ -853,6 +857,9 @@ def encode_requests(
             for inst in run["instances"]:
                 ctx_res = find_ctx_resource(ctx_resources, inst)
                 a["r_inst_run"][b, inst_slot] = j
+                a["r_inst_id"][b, inst_slot] = (
+                    it(inst) if isinstance(inst, str) else ABSENT
+                )
                 a["r_inst_valid"][b, inst_slot] = True
                 if ctx_res is not None:
                     a["r_inst_present"][b, inst_slot] = True
@@ -1012,6 +1019,13 @@ def encode_requests(
     # packed verdicts instead of the raw ra3/ra2/hr/owner-pair arrays
     # (which stay allocated for the ACL stage and the native ABI)
     a.update(pack_owner_bitplanes(a, compiled, skip=skip_owner_bits))
+    # relation-closure bitplanes (ReBAC, ops/relation.py): packed against
+    # the serving store's flat verdict tables; fail-closed without them
+    from .relation import pack_relation_bitplanes
+
+    a.update(pack_relation_bitplanes(
+        a, compiled, relation_tables, skip=skip_relation_bits
+    ))
 
     return RequestBatch(
         B=B,

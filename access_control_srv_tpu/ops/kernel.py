@@ -64,6 +64,15 @@ def tree_needs_hr(arrays: dict) -> bool:
     )
 
 
+def tree_needs_rel(arrays: dict) -> bool:
+    """Static gate for the relation-plane fold (ReBAC, ops/relation.py):
+    only target rows carrying a relation-path requirement can fail it, so
+    relation-free trees keep their lowered programs byte-identical (the
+    flag is Python-level, like with_hr)."""
+    t = arrays.get("t_rel_idx")
+    return t is not None and bool((np.asarray(t) >= 0).any())
+
+
 def pow2_bucket(n: int, floor: int = 8) -> int:
     """Smallest power of two >= n (min `floor`): the shared padding bucket
     used by every kernel entry so varying batch/entity sizes reuse a
@@ -377,6 +386,31 @@ def _hr_pass_from_bits(r: dict, v, collect, op_hit, hr_check, trivial):
     return trivial | (ctx_ok & ~bad)
 
 
+def _rel_pass_from_bits(r: dict, v, collect, direct, trivial):
+    """Relation-path gate from host-precomputed closure bitplanes
+    (ops/relation.pack_relation_bitplanes) — the ReBAC analog of
+    _hr_pass_from_bits over the same packed layout with nop=0 and the
+    !direct flag selecting plane B instead of hr_check.
+
+    Unlike the owner gate there is no ctx_ok/role-association term (the
+    relation check needs only the subject id and the targeted instances)
+    and no operation term (relation requirements apply to resource
+    instances only).  ``trivial`` is rows without a relation requirement
+    (t_rel_idx < 0); a collected run with any failing instance fails."""
+    runs = r["r_rel_runs"]  # [NRU]
+    nru = int(runs.shape[0])
+    vv = jnp.maximum(v, 0)
+    bit = _owner_bit_reader(r["r_rel_bits"], vv, 2 * nru)
+    bad = jnp.zeros(vv.shape, bool)
+    n_runs = int(collect.shape[-1])
+    for g in range(nru):
+        coll_g = jnp.zeros(vv.shape, bool)
+        for nr in range(n_runs):
+            coll_g = coll_g | ((runs[g] == nr) & collect[..., nr])
+        bad = bad | (coll_g & jnp.where(direct, bit(nru + g), bit(g)))
+    return trivial | ~bad
+
+
 def _hr_collect_state(c: dict, r: dict, rgx_hit, pfx_neq, ent_valid):
     """Stage B's signature-determined pieces, shared by the dense kernel
     and the components-mode planes builder: the per-(target row, entity
@@ -426,7 +460,8 @@ def _subject_ok(c: dict, r: dict):
 
 
 def _match_targets(c: dict, r: dict, with_hr: bool = True,
-                   wia: bool = False, components: bool = False):
+                   wia: bool = False, components: bool = False,
+                   with_rel: bool = False):
     """Stages A (target matching) + B (HR scopes) for one request: returns
     per-target-row match vectors the rule/policy stages gather from.
 
@@ -585,9 +620,10 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
             out["sig_wia_rg_d"] = no_res | state_final_rg
             out["sig_maybe_ex"] = has_props & ent_any_ex
             out["sig_maybe_rg"] = has_props & state_any_rg
-        if with_hr:
+        if with_hr or with_rel:
             # stage B's signature-determined parts — the owner side
-            # stays per-request (shared helper with the dense stage B)
+            # stays per-request (shared helper with the dense stage B);
+            # the relation fold reuses the same collection state
             collect, op_hit = _hr_collect_state(
                 c, r, rgx_hit, pfx_neq, ent_valid
             )
@@ -624,7 +660,7 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
         out["maybe_mask_rg"] = has_props & state_any_rg
 
     # ------------------------------------------------------------- B: HR scopes
-    if not with_hr:
+    if not with_hr and not with_rel:
         out["hr_pass"] = jnp.ones((T,), bool)
         return out
     # collection per (target, entity slot, run) with sticky state like the
@@ -634,10 +670,24 @@ def _match_targets(c: dict, r: dict, with_hr: bool = True,
     # bitplanes indexed by the (role, scoping) vocab (compile.py hrv_*,
     # encode.pack_owner_bitplanes), gathered per target row via t_rs_idx.
     collect, op_hit = _hr_collect_state(c, r, rgx_hit, pfx_neq, ent_valid)
-    hr_trivial = (c["t_n_subjects"] == 0) | ~c["t_has_scoping"]
-    out["hr_pass"] = _hr_pass_from_bits(
-        r, c["t_rs_idx"], collect, op_hit, c["t_hr_check"], hr_trivial
-    )
+    if with_hr:
+        hr_trivial = (c["t_n_subjects"] == 0) | ~c["t_has_scoping"]
+        hr = _hr_pass_from_bits(
+            r, c["t_rs_idx"], collect, op_hit, c["t_hr_check"], hr_trivial
+        )
+    else:
+        hr = jnp.ones((T,), bool)
+    if with_rel:
+        # relation-path fold (ReBAC): same collection state, packed
+        # closure planes gathered per target row via t_rel_idx; ANDed
+        # into hr_pass so both gate sites (hr_rule in _rule_predicates
+        # and the pol_subject gate) pick it up — mirroring the oracle's
+        # paired check_hierarchical_scope/check_target_relations calls
+        hr = hr & _rel_pass_from_bits(
+            r, c["t_rel_idx"], collect, c["t_rel_direct"],
+            c["t_rel_idx"] < 0,
+        )
+    out["hr_pass"] = hr
     return out
 
 
@@ -963,7 +1013,8 @@ def _per_set_effects(c: dict, contrib_present, contrib_eff, contrib_cach,
 
 
 def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
-                  with_hr: bool = True, explain: bool = False):
+                  with_hr: bool = True, explain: bool = False,
+                  with_rel: bool = False):
     """Decision for a single encoded request; vmapped over the batch.
 
     ``c``: compiled policy arrays (replicated across devices).
@@ -977,7 +1028,7 @@ def _evaluate_one(c: dict, r: dict, with_acl: bool = True,
     ``explain=True`` appends the packed provenance code (see
     _combine_and_decide).
     """
-    m = _match_targets(c, r, with_hr)
+    m = _match_targets(c, r, with_hr, with_rel=with_rel)
     return _evaluate_from_matches(c, r, m, with_acl, explain=explain)
 
 
@@ -1163,18 +1214,21 @@ class DecisionKernel:
         self.explain_strides = (compiled.KP, compiled.KR)
         self._shared = shared_jits if shared_jits is not None else {}
         # hrv_role/hrv_scope stay host-side (encode's owner-bit packer
-        # consumes them; the device programs read only packed bitplanes)
+        # consumes them; the device programs read only packed bitplanes).
+        # t_rel_path/relv_path likewise: the relation packer and the store
+        # consume them, the kernel reads only t_rel_idx + packed planes.
         self._c = {
             k: jnp.asarray(v) for k, v in compiled.arrays.items()
-            if k not in ("hrv_role", "hrv_scope")
+            if k not in ("hrv_role", "hrv_scope", "t_rel_path", "relv_path")
         }
         self._bake_constants = (
             not dynamic_policies and bake_policy_constants(compiled)
         )
         with_hr = tree_needs_hr(compiled.arrays)
+        with_rel = tree_needs_rel(compiled.arrays)
 
         def make_run(with_acl: bool):
-            key = ("dense", with_acl, with_hr)
+            key = ("dense", with_acl, with_hr, with_rel)
             if explain:
                 key = key + ("explain",)
             if dynamic_policies and key in self._shared:
@@ -1191,7 +1245,7 @@ class DecisionKernel:
                     rr = {**ra, "rgx_set": rs, "pfx_neq": pn,
                           "cond_true": ct, "cond_abort": ca, "cond_code": cc}
                     return _evaluate_one(c, rr, with_acl, with_hr,
-                                         explain=explain)
+                                         explain=explain, with_rel=with_rel)
 
                 return jax.vmap(one, in_axes=in_axes)(
                     batch_arrays, rgx_set, pfx_neq,

@@ -55,12 +55,14 @@ from .kernel import (
     _match_targets,
     _multi_entity_ok,
     _policy_gates_core,
+    _rel_pass_from_bits,
     _rule_conditions,
     half_pow2_bucket,
     lead_padding,
     pad_cols,
     pow2_bucket,
     tree_needs_hr,
+    tree_needs_rel,
 )
 
 # varying arrays the signature runner gathers per row (stage E-G inputs);
@@ -302,6 +304,7 @@ class PrefilteredKernel:
         # stages.  Batches with ACL pairs / request properties fall back
         # to the full per-row matcher.
         self.needs_hr = tree_needs_hr(compiled.arrays)
+        self.needs_rel = tree_needs_rel(compiled.arrays)
         self.active = compiled.n_rules >= MIN_RULES
         if not self.active:
             if mesh is not None:
@@ -318,15 +321,21 @@ class PrefilteredKernel:
                     shared_jits=self._shared, explain=self.explain,
                 )
         # hrv_role/hrv_scope are host-only since the owner-bitplane
-        # rewrite (consumed by encode's packer, never by a device program)
+        # rewrite (consumed by encode's packer, never by a device program);
+        # t_rel_path/relv_path likewise (relation packer + store only) —
+        # both are t_-prefixed/varying anyway, but keep the exclusion
+        # explicit for the invariant set
         self._c_inv = {
             k: jnp.asarray(v) for k, v in compiled.arrays.items()
-            if not _is_varying(k) and k not in ("hrv_role", "hrv_scope")
+            if not _is_varying(k)
+            and k not in ("hrv_role", "hrv_scope", "relv_path")
         }
 
-    def _runner(self, with_acl: bool, with_hr: bool):
+    def _runner(self, with_acl: bool, with_hr: bool, with_rel: bool = False):
         explain = self.explain
-        key = (with_acl, with_hr) + (("explain",) if explain else ())
+        key = (with_acl, with_hr, with_rel) + (
+            ("explain",) if explain else ()
+        )
         run = self._runs.get(key)
         if run is None:
             def body(c_inv, cs, g_idx, batch_arrays, rgx_set, pfx_neq,
@@ -339,7 +348,7 @@ class PrefilteredKernel:
                     rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq,
                           "cond_true": ct, "cond_abort": ca, "cond_code": cc}
                     return _evaluate_one(c, rr, with_acl, with_hr,
-                                         explain=explain)
+                                         explain=explain, with_rel=with_rel)
 
                 return jax.vmap(one)(
                     g_idx, batch_arrays,
@@ -398,7 +407,7 @@ class PrefilteredKernel:
         return lambda *args: jitted(self._c_inv, *args)
 
     def _sig_runner(self, schedule: tuple, needs_pairs: bool = True,
-                    with_hr: bool = False):
+                    with_hr: bool = False, with_rel: bool = False):
         """The signature-plane kernel in GROUP-DENSE slot layout: stage A
         (resource/action target matching) is pre-gathered to rule/policy/
         set granularity per signature (_planes_for), and the batch arrives
@@ -422,7 +431,7 @@ class PrefilteredKernel:
         three outputs return stacked as one [NSLOT, 3, R] readback."""
         explain = self.explain
         n_out = 4 if explain else 3
-        key = ("sig", schedule, needs_pairs, with_hr) + (
+        key = ("sig", schedule, needs_pairs, with_hr, with_rel) + (
             ("explain",) if explain else ()
         )
         run = self._runs.get(key)
@@ -551,6 +560,27 @@ class PrefilteredKernel:
                         )  # [S, KP]
                     else:
                         pol_subject = None
+                    if with_rel:
+                        # relation-path fold (ReBAC) at plane granularity:
+                        # same collection planes, packed closure bitplanes
+                        # from encode (ops/relation.pack_relation_bitplanes)
+                        M_ = KP_ * KR_
+                        rel_rule = _rel_pass_from_bits(
+                            rr, flat(sg["rl_rel_idx"]),
+                            sg["rl_collect"].reshape(S_, M_, -1),
+                            flat(sg["rl_rel_dir"]),
+                            flat(sg["rl_rel_idx"]) < 0,
+                        )  # [S, M]
+                        rel_pol = _rel_pass_from_bits(
+                            rr, sg["pl_rel_idx"], sg["pl_collect"],
+                            sg["pl_rel_dir"], sg["pl_rel_idx"] < 0,
+                        )  # [S, KP]
+                        reached = reached & (~rht_f | rel_rule)
+                        pol_rel = ~c["pol_has_subjects"] | rel_pol
+                        pol_subject = (
+                            pol_rel if pol_subject is None
+                            else pol_subject & pol_rel
+                        )
                     kind = _action_kind(c, rr)
                     short = rr["r_acl_short"]
                     acl_row = flat(sg["rl_skip"]) | (short == 1) | (
@@ -665,13 +695,15 @@ class PrefilteredKernel:
             }
             if self._bits_fn is None:
                 with_hr = self.needs_hr
+                with_rel = self.needs_rel
 
                 def bits_fn(c_inv, cs, rr):
                     def one(g, r_row):
                         c = {**c_inv,
                              **jax.tree_util.tree_map(lambda x: x[g], cs)}
                         comp = _match_targets(
-                            c, r_row, with_hr=with_hr, components=True
+                            c, r_row, with_hr=with_hr, components=True,
+                            with_rel=with_rel,
                         )
                         act = comp["sig_act_ok"]
                         rt = c["rule_target"]
@@ -720,8 +752,34 @@ class PrefilteredKernel:
                                     c["t_hr_check"], pt, axis=0
                                 ),
                             }
+                        rel_planes = {}
+                        if with_rel:
+                            rel_planes = {
+                                "rl_rel_idx": jnp.take(
+                                    c["t_rel_idx"], rt, axis=0
+                                ),
+                                "rl_rel_dir": jnp.take(
+                                    c["t_rel_direct"], rt, axis=0
+                                ),
+                                "pl_rel_idx": jnp.take(
+                                    c["t_rel_idx"], pt, axis=0
+                                ),
+                                "pl_rel_dir": jnp.take(
+                                    c["t_rel_direct"], pt, axis=0
+                                ),
+                            }
+                            if not with_hr:
+                                # collection planes otherwise come with
+                                # the HR set; rel-only trees need them too
+                                rel_planes["rl_collect"] = jnp.take(
+                                    comp["sig_collect"], rt, axis=0
+                                )
+                                rel_planes["pl_collect"] = jnp.take(
+                                    comp["sig_collect"], pt, axis=0
+                                )
                         return {
                             **hr_planes,
+                            **rel_planes,
                             "rl_ex": jnp.where(
                                 deny, g_(comp["sig_res_ex_d"], rt),
                                 g_(comp["sig_res_ex_p"], rt)
@@ -758,7 +816,7 @@ class PrefilteredKernel:
                     return jax.vmap(one)(jnp.arange(G), rr)
 
                 self._bits_fn = self._wrap_runner(
-                    ("bits", self.needs_hr), bits_fn, None
+                    ("bits", self.needs_hr, self.needs_rel), bits_fn, None
                 )
             varying = {k: v for k, v in stacked.items()}
             bits = jax.tree_util.tree_map(
@@ -1047,7 +1105,10 @@ class PrefilteredKernel:
             # the buffer (and the slot/readback maps below) comes from the
             # staging pool and is released at materialize — the depth-N
             # pipeline allocates nothing per batch on this path
-            r_keys = _SIG_R_KEYS_HR if self.needs_hr else _SIG_R_KEYS
+            r_keys = list(_SIG_R_KEYS_HR if self.needs_hr else _SIG_R_KEYS)
+            if self.needs_rel:
+                # relation closure planes ride the same packed row buffer
+                r_keys += ["r_rel_runs", "r_rel_bits"]
             schedule = []
             widths = []
             for k in r_keys:
@@ -1147,7 +1208,8 @@ class PrefilteredKernel:
                      & (np.asarray(stacked["t_n_subjects"]) > 0)).any()
                 )
                 run = self._sig_runner(
-                    tuple(schedule), needs_pairs, with_hr=self.needs_hr
+                    tuple(schedule), needs_pairs, with_hr=self.needs_hr,
+                    with_rel=self.needs_rel,
                 )
                 # rule_orig_flat rides along only in explain mode — adding
                 # it unconditionally would change the runner's argument
@@ -1205,6 +1267,7 @@ class PrefilteredKernel:
         run = self._runner(
             bool((np.asarray(batch.arrays["r_acl_ent"]) >= 0).any()),
             tree_needs_hr(stacked),
+            tree_needs_rel(stacked),
         )
         out = run(
             stacked,

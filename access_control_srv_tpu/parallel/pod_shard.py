@@ -70,6 +70,7 @@ from ..ops.kernel import (
     _policy_gates,
     _rule_predicates,
     pack_rule_key,
+    tree_needs_rel,
     unpack_rule_key,
 )
 from .mesh import pad_batch, wrap_shard_map
@@ -178,7 +179,8 @@ def partition_sets(compiled: CompiledPolicies, n_shards: int
     )
 
 
-def _evaluate_set_chunk(c, r, s_offset, model_axis, explain: bool = False):
+def _evaluate_set_chunk(c, r, s_offset, model_axis, explain: bool = False,
+                        with_rel: bool = False):
     """Per-device evaluation of one SET chunk for one request.  Stages A-F
     run locally through the shared single-device helpers (whole sets are
     shard-local, so every combining algorithm is local); only the
@@ -192,7 +194,7 @@ def _evaluate_set_chunk(c, r, s_offset, model_axis, explain: bool = False):
     owning shard recovers the full provenance locally and broadcasts the
     packed code with one extra ``pmax`` (codes are >= 1 whenever any set
     contributed; non-owners contribute 0)."""
-    m = _match_targets(c, r)
+    m = _match_targets(c, r, with_rel=with_rel)
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(
         c, r, m
     )
@@ -384,7 +386,8 @@ class PodShardedKernel:
         """The jitted shard_map program, registered under the shared-jit
         table (srv/evaluator.py) so patched/recompiled kernels with
         identical table shapes reuse the existing executables."""
-        key = ("pod", self.model_axis, self.n_shards)
+        with_rel = tree_needs_rel(self.compiled.arrays)
+        key = ("pod", self.model_axis, self.n_shards, with_rel)
         if self.explain:
             key = key + ("explain",)
         jitted = self._shared.get(key)
@@ -402,7 +405,8 @@ class PodShardedKernel:
             def one(ra):
                 rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq}
                 return _evaluate_set_chunk(
-                    c_local, rr, s_offset, model_axis, explain=explain
+                    c_local, rr, s_offset, model_axis, explain=explain,
+                    with_rel=with_rel,
                 )
 
             return jax.vmap(one)(batch_arrays)

@@ -43,14 +43,19 @@ from ..ops.kernel import (
     _rule_predicates,
     pack_rule_key,
     unpack_rule_key,
+    tree_needs_rel,
 )
 
-# target-table fields partitioned per shard (see compile.py _TargetTable)
+# target-table fields partitioned per shard (see compile.py _TargetTable).
+# t_rel_idx stays a GLOBAL relation-vocab index (the packed closure planes
+# are vocab-wide), so no per-shard remap; t_rel_path is host-only and
+# never ships.
 _T_FIELDS = [
     "t_n_subjects", "t_role", "t_has_role", "t_scoping", "t_has_scoping",
     "t_hr_check", "t_skip_acl", "t_sub_ids", "t_sub_vals", "t_act_ids",
     "t_act_vals", "t_ent_vals", "t_ent_w", "t_ent_tails", "t_op_vals",
     "t_prop_vals", "t_prop_sfx", "t_has_props", "t_n_res", "t_rs_idx",
+    "t_rel_idx", "t_rel_direct",
 ]
 
 
@@ -139,7 +144,7 @@ def partition_rules(compiled: CompiledPolicies, n_shards: int) -> _Partitioned:
 
 
 def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis,
-                    explain: bool = False):
+                    explain: bool = False, with_rel: bool = False):
     """Per-device evaluation of one rule chunk for one request, with
     cross-``model`` packed positional reductions.  Stages A-D reuse the
     single-device kernel helpers against this shard's compacted target
@@ -150,7 +155,7 @@ def _evaluate_chunk(c, r, kr_offset, kr_total, model_axis,
     already carry GLOBAL rule positions in the key high bits, so after
     the pmin/pmax merges every device holds the winner's identity — the
     explain code is recovered locally with zero extra collectives."""
-    m = _match_targets(c, r)
+    m = _match_targets(c, r, with_rel=with_rel)
     reached, acl_rule, has_cond, cond_t, cond_a, cond_c = _rule_predicates(c, r, m)
     pol_gate, set_gate, pol_subject = _policy_gates(c, r, m)
 
@@ -318,6 +323,7 @@ class RuleShardedKernel:
             jnp.asarray(part.kr_offsets), NamedSharding(mesh, P(model_axis))
         )
         kr_total = self._kr_total
+        with_rel = tree_needs_rel(compiled.arrays)
 
         c_specs = {k: P(model_axis) for k in self._c}
 
@@ -329,7 +335,7 @@ class RuleShardedKernel:
                 rr = {**ra, "rgx_set": rgx_set, "pfx_neq": pfx_neq}
                 return _evaluate_chunk(
                     c_local, rr, kr_offset, kr_total, model_axis,
-                    explain=explain,
+                    explain=explain, with_rel=with_rel,
                 )
 
             return jax.vmap(one)(batch_arrays)

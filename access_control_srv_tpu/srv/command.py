@@ -187,6 +187,13 @@ class CommandInterface:
                 # device-health posture: quarantine state, timeout/restore
                 # counts, cumulative degraded seconds (srv/watchdog.py)
                 detail["device_watchdog"] = watchdog.status()
+            relation_store = getattr(self.worker, "relation_store", None)
+            if relation_store is not None:
+                # ReBAC posture: tuple/rewrite counts, store generation,
+                # closure-cache size and the table fingerprint replicas
+                # must agree on (srv/relations.py) — absent with
+                # relations off, so the surface is unchanged
+                detail["relations"] = relation_store.stats()
             shadow = getattr(self.worker, "shadow", None)
             if shadow is not None:
                 # candidate-tree staging posture: epoch, queue depth,

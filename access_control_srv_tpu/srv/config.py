@@ -263,6 +263,16 @@ DEFAULT_CONFIG: dict = {
         # bounded mirror queue (batches); overflow drops + counts
         "queue_batches": 64,
     },
+    # ReBAC relation tuples (srv/relations.py, docs/REBAC.md).  Disabled
+    # by default: no store is built, and relation-bearing policy targets
+    # fail closed on every path (oracle and kernel agree).  Enabled: a
+    # Zanzibar-style tuple store feeds the stage-B bit-reader's relation
+    # planes; tuple CRUD rides the journaled topic below (broker bus =
+    # shared durable tuple store, replayed at boot, origin-skip live).
+    "relations": {
+        "enabled": False,
+        "topic": "io.restorecommerce.relation-tuples.resource",
+    },
     "logger": {"maskFields": ["password", "token"]},
 }
 

@@ -442,6 +442,12 @@ class HybridEvaluator:
             # on the SLICED tables too (shard boundaries, compacted
             # per-shard target subtables), not just the pod-level arrays
             h.update(sharding["pod_fingerprint"].encode())
+        store = getattr(self.engine, "relation_store", None)
+        if store is not None:
+            # the relation-tuple state decides relation-bearing rows, so
+            # replica convergence must cover it too (two replicas with
+            # equal policy tables but divergent tuple logs must differ)
+            h.update(store.fingerprint().encode())
         return h.hexdigest()
 
     # ------------------------------------------------------ full compile
@@ -638,6 +644,110 @@ class HybridEvaluator:
                 self.logger.info("native encoder disabled: %s", err)
             return None
 
+    # --------------------------------------------------- relation plumbing
+
+    def attach_relation_store(self, store) -> None:
+        """Wire a RelationTupleStore (srv/relations.py): the oracle gate
+        reads it through ``engine.relation_store``, encode pulls the flat
+        verdict tables per batch, and every tuple write bumps the
+        decision cache — tuple churn changes decisions without any policy
+        CRUD, but swaps NO program: the compiled tables, the kernel and
+        every jitted executable stay byte-identical (the ReBAC serving
+        invariant, tpu_compat_audit rebac-zero-matmul-program-identity)."""
+        self.engine.relation_store = store
+
+        def _on_change(_gen: int) -> None:
+            if self.decision_cache is not None:
+                self.decision_cache.bump_epoch()
+            self._count_path("relation-churn", 1)
+
+        store.on_change(_on_change)
+
+    def _relation_tables(self, compiled):
+        """The store's flat verdict tables for this compile, or None
+        (encode then packs fail-closed planes / dummies)."""
+        store = getattr(self.engine, "relation_store", None)
+        if store is None or compiled is None:
+            return None
+        from ..ops.relation import relation_bits_needed
+
+        if not relation_bits_needed(compiled):
+            return None
+        return store.tables_for(compiled)
+
+    def _relation_tables_native(self, encoder):
+        """Verdict tables in the NATIVE encoder's id space for the wire
+        path — the C++ interner diverges from the Python one after the
+        preload snapshot, so the host-space tables cannot be reused."""
+        store = getattr(self.engine, "relation_store", None)
+        if store is None or not encoder.needs_relation_bits:
+            return None
+        return encoder.native_relation_tables(store)
+
+    def _relation_provenance(self, request, source_id):
+        """Tuple-path witnesses for a relation-decided explain row: when
+        the deciding node's target carries relation-path attributes, walk
+        the live tuple graph for the hop list that satisfied each (path,
+        instance) pair — the ReBAC analog of the rule-id stamp.  None
+        whenever the row wasn't relation-gated (no store, no relation
+        attrs, nothing collected), so non-ReBAC explain output is
+        byte-identical."""
+        store = getattr(self.engine, "relation_store", None)
+        if store is None or source_id is None:
+            return None
+        target = self._node_target(source_id)
+        if target is None:
+            return None
+        from ..core.relation_path import (
+            collect_target_instances,
+            relation_paths,
+            request_subject_id,
+        )
+
+        urns = self.engine.urns
+        paths = relation_paths(
+            target.subjects if target is not None else None, urns
+        )
+        if not paths:
+            return None
+        instances = collect_target_instances(target, request, urns)
+        subject_id = request_subject_id(request)
+        if not instances or subject_id is None:
+            return None
+        witnesses = []
+        for expr in paths:
+            for ns, oid in instances:
+                hops = store.witness(expr, ns, oid, subject_id)
+                if hops is not None:
+                    witnesses.append({
+                        "path": expr,
+                        "object": f"{ns}:{oid}",
+                        "tuples": hops,
+                    })
+        return witnesses or None
+
+    def _node_target(self, source_id):
+        """The target of the tree node ``source_id`` names — deciding
+        rule first, then no-rules policy (same precedence as
+        ExplainDecoder.describe_source); None when the id left the tree
+        under a hot mutation (provenance then degrades, never raises)."""
+        for ps in self.engine.policy_sets.values():
+            if ps is None:
+                continue
+            for pol in ps.combinables.values():
+                if pol is None:
+                    continue
+                for rule in pol.combinables.values():
+                    if rule is not None and rule.id == source_id:
+                        return rule.target
+        for ps in self.engine.policy_sets.values():
+            if ps is None:
+                continue
+            for pol in ps.combinables.values():
+                if pol is not None and pol.id == source_id:
+                    return pol.target
+        return None
+
     @property
     def kernel_active(self) -> bool:
         return self._kernel is not None
@@ -767,7 +877,10 @@ class HybridEvaluator:
             return None
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
-        batch = encoder.encode_wire(messages, reuse=reuse)
+        rel_tables = self._relation_tables_native(encoder)
+        batch = encoder.encode_wire(
+            messages, reuse=reuse, relation_tables=rel_tables
+        )
         if tracer is not None:
             from .tracing import STAGE_WIRE_ENCODE
 
@@ -795,7 +908,8 @@ class HybridEvaluator:
                     if batch.overcap[b] and not batch.eligible[b]
                 ]
                 retry = encoder.encode_wire(
-                    [messages[b] for b in idx], caps=dict(_CAPS_CEIL)
+                    [messages[b] for b in idx], caps=dict(_CAPS_CEIL),
+                    relation_tables=rel_tables,
                 )
                 d2, c2, s2 = self._guard_materialize(
                     kernel.evaluate_async(retry)
@@ -1051,6 +1165,11 @@ class HybridEvaluator:
             )
             if info is not None:
                 response._explain = info
+                rel = self._relation_provenance(
+                    request, info.get("rule") or info.get("policy")
+                )
+                if rel is not None:
+                    info["relation"] = rel
         return response
 
     def what_is_allowed(self, request):
@@ -1099,10 +1218,12 @@ class HybridEvaluator:
             with self._lock:
                 if self._compiled is compiled:
                     self._rq_kernel = rq_kernel
-        # reverse queries never reach stage B: skip the owner-bit packer
-        # (and the condition pre-pass) on this encode
+        # reverse queries never reach stage B: skip the owner-bit packer,
+        # the relation-plane packer (wia ignores relation requirements,
+        # like the HR gate) and the condition pre-pass on this encode
         batch = encode_requests(
-            requests, compiled, skip_conditions=True, skip_owner_bits=True
+            requests, compiled, skip_conditions=True, skip_owner_bits=True,
+            skip_relation_bits=True,
         )
         out = what_is_allowed_batch(
             self.engine, compiled, rq_kernel, requests, batch
@@ -1313,7 +1434,8 @@ class HybridEvaluator:
         tracer = self.obs.tracer if self.obs is not None else None
         t_stage = time.perf_counter() if tracer is not None else 0.0
         batch = encode_requests(
-            requests, compiled, self.engine.resource_adapter, caps=caps
+            requests, compiled, self.engine.resource_adapter, caps=caps,
+            relation_tables=self._relation_tables(compiled),
         )
         if tracer is not None:
             from .tracing import STAGE_ENCODE
@@ -1413,6 +1535,14 @@ class HybridEvaluator:
                 info = decoder.decode(expl[b])
                 if info is not None:
                     resp._explain = info
+                    rel = self._relation_provenance(
+                        request, info.get("rule") or info.get("policy")
+                    )
+                    if rel is not None:
+                        # relation-decided row: the tuple-path hop list
+                        # that let this subject through the deciding
+                        # node's relation gate (srv/relations.witness)
+                        info["relation"] = rel
                 source = decoder.source(expl[b])
                 if source is not None:
                     # identical to the oracle's EffectEvaluation.source

@@ -611,6 +611,11 @@ class Telemetry:
         # counts, fed by the registry's on_hit hook — operators see
         # exactly which failpoints fired and how often
         self.failpoints = Counter()
+        # relation-tuple store (srv/relations.py): tuples_created /
+        # tuples_deleted / rewrites / replicated-frame counts — the ReBAC
+        # churn surface (tuple writes swap no program, so this counter is
+        # the only operator-visible trace of relation mutations)
+        self.relations = Counter()
         # shadow evaluation (srv/shadow.py): candidate-vs-production
         # decision diffs keyed by transition ("PERMIT->DENY", ...) plus
         # lifecycle events (evaluated/dropped/errors).  Both stay empty —
@@ -683,6 +688,9 @@ class Telemetry:
         reg.counter("acs_failpoint_hits_total",
                     "Deterministic fault-injection hits per site "
                     "(srv/faults.py)", self.failpoints, label="site")
+        reg.counter("acs_relation_events_total",
+                    "Relation-tuple store events (srv/relations.py)",
+                    self.relations, label="event")
         reg.counter("acs_shadow_diffs_total",
                     "Candidate-vs-production decision diffs by transition "
                     "(srv/shadow.py)", self.shadow_diffs,
@@ -810,6 +818,9 @@ class Telemetry:
             # was served — untenanted workers keep the exact legacy shape
             if tenant_events:
                 out["tenants"] = tenant_events
+            relation_events = self.relations.snapshot()
+            if relation_events:
+                out["relations"] = relation_events
             shadow_events = self.shadow.snapshot()
             shadow_diffs = self.shadow_diffs.snapshot()
             if shadow_events or shadow_diffs:

@@ -63,6 +63,7 @@ class Worker:
         self.mesh = None
         self.obs = None  # srv/tracing.Observability (None = disabled)
         self.replicator = None
+        self.relation_store = None  # srv/relations.RelationTupleStore
         self.tenancy = None  # srv/tenancy.TenantRegistry (None = off)
         self.watchdog = None  # srv/watchdog.DeviceWatchdog (None = off)
         self._faults_armed = False
@@ -508,6 +509,30 @@ class Worker:
                 )
             )
 
+        # Zanzibar-style relation tuples (srv/relations.py): host-side
+        # tuple store behind the stage-B bit-reader's relation planes.
+        # Off by default (relations:enabled) — the engine then treats
+        # relation-bearing targets fail-closed.  Over a broker bus the
+        # journaled tuple topic IS the shared durable tuple store (same
+        # role the CRUD topics play for policies): replay at boot, then
+        # follow live frames from other workers via origin-skip.
+        self.relation_store = None
+        if cfg.get("relations:enabled"):
+            from .relations import RelationTupleStore
+
+            self.relation_store = RelationTupleStore(
+                bus=self.bus,
+                topic=cfg.get(
+                    "relations:topic",
+                    "io.restorecommerce.relation-tuples.resource",
+                ),
+                logger=self.logger,
+                telemetry=self.telemetry,
+            )
+            self.relation_store.replay()
+            self.relation_store.start_replication()
+            self.evaluator.attach_relation_store(self.relation_store)
+
         # shadow evaluation (srv/shadow.py): candidate tree beside
         # production on the same compiled programs, fed from the service
         # facade off the response path.  Built LAST so the production
@@ -556,6 +581,8 @@ class Worker:
             self.tenancy.shutdown()
         if getattr(self, "replicator", None) is not None:
             self.replicator.stop()
+        if getattr(self, "relation_store", None) is not None:
+            self.relation_store.stop()
         if getattr(self, "store", None) is not None:
             for collection in self.store.collections.values():
                 collection.close()

@@ -2860,6 +2860,278 @@ def bench_shadow_diff():
         worker.stop()
 
 
+# ------------------------------------------- config 24/25: ReBAC workload
+
+
+def _rebac_setup(n_tuples: int, n_objects: int, depth: int):
+    """One relation-bearing tree + a populated tuple store: ``n_objects``
+    documents behind folder chains of ``depth`` hops (path expression
+    ``viewer|parent....owner``), tuple budget filled with direct viewer
+    edges.  Returns (engine, compiled, store, tuple count)."""
+    import tempfile
+
+    from access_control_srv_tpu.core import AccessController, populate
+    from access_control_srv_tpu.ops import compile_policies
+    from access_control_srv_tpu.srv.relations import RelationTupleStore
+
+    path = "viewer|" + ".".join(["parent"] * (depth - 1) + ["owner"])
+    src = os.path.join(REPO, "tests", "fixtures", "relation_policies.yml")
+    text = open(src).read().replace("value: viewer", f"value: {path}")
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".yml", delete=False
+    ) as fh:
+        fh.write(text)
+        fixture_path = fh.name
+    try:
+        engine = AccessController()
+        populate(engine, fixture_path)
+    finally:
+        os.unlink(fixture_path)
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported, compiled.unsupported_reason
+
+    doc = "urn:restorecommerce:acs:model:document.Document"
+    folder = "urn:restorecommerce:acs:model:folder.Folder"
+    n_chains = max(1, n_objects // 64)  # 64 docs share one folder chain
+    tuples: list[tuple] = []
+    for c in range(n_chains):
+        for h in range(depth - 2):
+            tuples.append((folder, f"f{c}_{h}", "parent",
+                           {"object": {"entity": folder,
+                                       "id": f"f{c}_{h + 1}"}}))
+        tuples.append((folder, f"f{c}_{max(depth - 2, 0)}", "owner",
+                       f"chain-owner-{c % 512}"))
+    for i in range(n_objects):
+        tuples.append((doc, f"doc{i}", "parent",
+                       {"object": {"entity": folder, "id": f"f{i % n_chains}_0"}}))
+    # fill the remaining budget with direct viewer edges (the Zanzibar
+    # bulk: most tuples are leaf grants, the chains are the deep tail)
+    i = 0
+    while len(tuples) < n_tuples:
+        tuples.append((doc, f"doc{i % n_objects}", "viewer",
+                       f"viewer-{i % 4096}"))
+        i += 1
+    store = RelationTupleStore()
+    store.create(tuples)
+    engine.relation_store = store
+    return engine, compiled, store, len(tuples), doc
+
+
+def _rebac_requests(doc: str, n_objects: int, batch: int):
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    n_chains = max(1, n_objects // 64)
+    rng = np.random.default_rng(11)
+    requests = []
+    for i in range(batch):
+        draw = rng.random()
+        rid_idx = int(rng.integers(n_objects))
+        if draw < 0.45:
+            # direct viewer hit: the fill loop grants doc d to
+            # viewer-((d + k*n_objects) % 4096) for the first ~8 k's
+            k = int(rng.integers(6))
+            subject = f"viewer-{(rid_idx + k * n_objects) % 4096}"
+        elif draw < 0.8:     # deep-chain owner hit via parent....owner
+            subject = f"chain-owner-{(rid_idx % n_chains) % 512}"
+        else:                # miss
+            subject = f"stranger-{i}"
+        rid = f"doc{rid_idx}"
+        requests.append(Request(
+            target=Target(
+                subjects=[Attribute(id=urns["role"], value="member"),
+                          Attribute(id=urns["subjectID"], value=subject)],
+                resources=[Attribute(id=urns["entity"], value=doc),
+                           Attribute(id=urns["resourceID"], value=rid)],
+                actions=[Attribute(id=urns["actionID"],
+                                   value=urns["read"])],
+            ),
+            context={"resources": [],
+                     "subject": {"id": subject, "role_associations": [],
+                                 "hierarchical_scopes": []}},
+        ))
+    return requests
+
+
+def bench_rebac_serve():
+    """ReBAC serving throughput (srv/relations.py, docs/REBAC.md):
+    relationship-gated decisions over a ~1M-tuple Zanzibar graph
+    (100k documents behind deep folder chains).  The closure is folded
+    host-side into flat verdict tables ONCE per tuple generation; the
+    device program reads two packed bitplanes per row, so the bar is
+    relation-bearing throughput within 25% of the SAME program fed
+    empty relation planes — tuples must price like bits, not like
+    joins.  A scalar-oracle parity spot-check runs before any timing."""
+    from access_control_srv_tpu.ops import DecisionKernel, encode_requests
+
+    n_tuples = int(os.environ.get("REBAC_TUPLES", 1_000_000))
+    n_objects = int(os.environ.get("REBAC_OBJECTS", 100_000))
+    depth = int(os.environ.get("REBAC_DEPTH", 4))
+    batch = int(os.environ.get("REBAC_BATCH", 4096))
+    total = int(os.environ.get("REBAC_TOTAL", 1 << 15))
+
+    engine, compiled, store, actual_tuples, doc = _rebac_setup(
+        n_tuples, n_objects, depth
+    )
+    requests = _rebac_requests(doc, n_objects, batch)
+
+    t0 = time.perf_counter()
+    tables = store.tables_for(compiled)
+    fold_ms = (time.perf_counter() - t0) * 1e3
+
+    kern = DecisionKernel(compiled)
+    bench_batch = encode_requests(requests, compiled,
+                                  relation_tables=tables)
+    code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    dec, _, _ = kern.evaluate(bench_batch)
+    permits = 0
+    for i in range(0, batch, max(1, batch // 24)):
+        expected = engine.is_allowed(requests[i])
+        assert dec[i] == code[expected.decision], (i, expected.decision)
+        permits += int(expected.decision == "PERMIT")
+    assert permits, "traffic draw must include relation hits"
+
+    plain_batch = encode_requests(requests, compiled,
+                                  skip_relation_bits=True)
+
+    def timed(b):
+        kern.evaluate(b)  # warmup (the plain batch's 1-wide dummy
+        # planes are their own jit shape)
+        iters = max(1, total // batch)
+        t1 = time.perf_counter()
+        pending = []
+        for _ in range(iters):
+            if len(pending) >= 3:
+                pending.pop(0)()
+            pending.append(kern.evaluate_async(b))
+        for p in pending:
+            p()
+        return batch * iters / (time.perf_counter() - t1)
+
+    rel_rps = timed(bench_batch)
+    plain_rps = timed(plain_batch)
+    overhead_pct = (plain_rps / rel_rps - 1.0) * 100.0
+    return _result(
+        f"rebac isAllowed decisions/sec/chip "
+        f"({actual_tuples}-tuple graph, {n_objects} objects, "
+        f"depth-{depth} chains)",
+        rel_rps,
+        "decisions/s",
+        {
+            "tuples": actual_tuples, "objects": n_objects,
+            "depth": depth, "batch": batch,
+            "closure_fold_ms": round(fold_ms, 1),
+            "plain_planes_rps": round(plain_rps, 1),
+            "overhead_pct": round(overhead_pct, 1),
+            "overhead_ok": bool(overhead_pct < 25.0),
+            "bar": "relation-gated throughput within 25% of the same "
+                   "program on empty relation planes; decisions "
+                   "spot-checked against the scalar path oracle before "
+                   "timing (tests/test_relations.py differential)",
+        },
+    )
+
+
+def bench_rebac_churn():
+    """Tuple-churn time-to-visibility (srv/relations.py): create/delete
+    a grant, rebuild the verdict tables (dependency-scoped closure memo:
+    only entries whose inputs changed recompute), re-encode and serve —
+    vs a cold store folding the same graph from scratch.  In-capacity
+    churn swaps no compiled program (audit row
+    rebac-zero-matmul-program-identity); the bar is patched median TTV
+    >= 3x lower than the cold fold on a deep-chain graph."""
+    from access_control_srv_tpu.ops import DecisionKernel, encode_requests
+    from access_control_srv_tpu.srv.relations import RelationTupleStore
+
+    n_tuples = int(os.environ.get("REBAC_CHURN_TUPLES", 200_000))
+    n_objects = int(os.environ.get("REBAC_CHURN_OBJECTS", 20_000))
+    depth = int(os.environ.get("REBAC_DEPTH", 4))
+    batch = int(os.environ.get("REBAC_CHURN_BATCH", 512))
+    n_mut = int(os.environ.get("REBAC_CHURN_MUTATIONS", 12))
+    n_cold = int(os.environ.get("REBAC_CHURN_COLD_FOLDS", 3))
+
+    engine, compiled, store, actual_tuples, doc = _rebac_setup(
+        n_tuples, n_objects, depth
+    )
+    requests = _rebac_requests(doc, n_objects, batch)
+    kern = DecisionKernel(compiled)
+    kern.evaluate(encode_requests(
+        requests, compiled, relation_tables=store.tables_for(compiled)
+    ))  # warm: programs compiled, closure memo hot
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    code = {"INDETERMINATE": 0, "PERMIT": 1, "DENY": 2}
+    probe_rid, probe_subject = "doc0", "churn-probe-user"
+    probe = Request(
+        target=Target(
+            subjects=[Attribute(id=urns["role"], value="member"),
+                      Attribute(id=urns["subjectID"], value=probe_subject)],
+            resources=[Attribute(id=urns["entity"], value=doc),
+                       Attribute(id=urns["resourceID"], value=probe_rid)],
+            actions=[Attribute(id=urns["actionID"], value=urns["read"])],
+        ),
+        context={"resources": [],
+                 "subject": {"id": probe_subject, "role_associations": [],
+                             "hierarchical_scopes": []}},
+    )
+
+    ttvs = []
+    for m in range(n_mut):
+        grant = (doc, probe_rid, "viewer", probe_subject)
+        t0 = time.perf_counter()
+        if m % 2 == 0:
+            store.create([grant])
+        else:
+            store.delete([grant])
+        b = encode_requests(requests + [probe], compiled,
+                            relation_tables=store.tables_for(compiled))
+        dec, _, _ = kern.evaluate(b)
+        ttvs.append((time.perf_counter() - t0) * 1e3)
+        expected = engine.is_allowed(probe)
+        assert dec[batch] == code[expected.decision], m
+        assert expected.decision == ("PERMIT" if m % 2 == 0 else "DENY")
+    ttv_p50 = float(np.median(ttvs))
+
+    # the comparison point: folding the SAME graph with a cold closure
+    # memo (what every churn would cost without dependency-scoped
+    # invalidation)
+    cold_ms = []
+    for _ in range(n_cold):
+        cold = RelationTupleStore()
+        for (ns, rel), rules in store.graph.rewrites.items():
+            cold.set_rewrite(ns, rel, rules)
+        cold.create([
+            (ns, oid, rel, subj)
+            for (ns, oid, rel), subjects in store.graph.tuples.items()
+            for subj in subjects
+        ])
+        t0 = time.perf_counter()
+        cold.tables_for(compiled)
+        cold_ms.append((time.perf_counter() - t0) * 1e3)
+    cold_p50 = float(np.median(cold_ms))
+    speedup = cold_p50 / max(ttv_p50, 1e-6)
+    return _result(
+        f"rebac tuple-churn time-to-visibility speedup, scoped patch vs "
+        f"cold closure fold ({actual_tuples}-tuple graph)",
+        speedup,
+        "x",
+        {
+            "tuples": actual_tuples, "objects": n_objects,
+            "depth": depth, "batch": batch, "mutations": n_mut,
+            "ttv_ms_p50": round(ttv_p50, 1),
+            "cold_fold_ms_p50": round(cold_p50, 1),
+            "speedup_ok": bool(speedup >= 3.0),
+            "bar": ">=3x lower median time-to-visibility than a cold "
+                   "closure fold of the same graph, with the mutated "
+                   "grant's decision flip asserted visible (and correct "
+                   "vs the oracle) on every mutation; zero new XLA "
+                   "compiles (audit rebac-zero-matmul-program-identity)",
+        },
+    )
+
+
 HOST_ONLY = {"scalar", "wia", "overload", "cluster-scale", "tenant-scale"}
 
 # ROADMAP carry-over: the evidence rows stamped [cpu-fallback] while the
@@ -2869,6 +3141,7 @@ REFRESH_ONCHIP = [
     "stress-hr", "token-mix", "adapter-mixed", "crud-churn", "serve",
     "serve-latency", "wire-profile", "wire-pipeline", "overload",
     "cluster-scale", "shard-scale", "explain-overhead", "shadow-diff",
+    "rebac-serve", "rebac-churn",
 ]
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -2882,7 +3155,7 @@ def main():
                              "crud-churn", "shard-scale", "overload",
                              "degraded-mode", "cluster-scale",
                              "tenant-scale", "explain-overhead",
-                             "shadow-diff"]
+                             "shadow-diff", "rebac-serve", "rebac-churn"]
     if "refresh-onchip" in which:
         # expand the runlist in place (dedup keeps explicit extras)
         expanded = []
@@ -2979,6 +3252,8 @@ def main():
         "tenant-scale": bench_tenant_scale,
         "explain-overhead": bench_explain_overhead,
         "shadow-diff": bench_shadow_diff,
+        "rebac-serve": bench_rebac_serve,
+        "rebac-churn": bench_rebac_churn,
     }
     for name in which:
         row = fns[name]()

@@ -8,6 +8,7 @@ jax.config instead."""
 import os
 import signal
 import sys
+import tempfile
 
 import pytest
 
@@ -20,6 +21,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite compiles the same fixture
+# programs from many modules (dense/prefilter/shard/explain variants over
+# the same shapes), and CPU backend compiles dominate tier-1 wall clock.
+# Keyed on HLO, so later modules hit entries written by earlier ones even
+# on a cold run; repeated runs start warm.  Honors an externally-set
+# JAX_COMPILATION_CACHE_DIR; errors degrade to a plain compile (JAX
+# default jax_raise_persistent_cache_errors=False).
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    tempfile.gettempdir(), "acs_jax_compile_cache"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

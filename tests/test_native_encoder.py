@@ -584,3 +584,155 @@ def test_owner_bits_fuzz_matches_python_packer(seed):
                                   err_msg=f"seed {seed} runs")
     np.testing.assert_array_equal(ref["r_own_bits"], got["r_own_bits"],
                                   err_msg=f"seed {seed} bits")
+
+
+def _relation_encoder():
+    from .utils import fixture
+
+    from access_control_srv_tpu.core import AccessController, populate
+
+    engine = AccessController()
+    populate(engine, fixture("relation_policies.yml"))
+    compiled = compile_policies(engine.policy_sets, engine.urns)
+    assert compiled.supported
+    enc = native.NativeBatchEncoder(compiled)
+    assert enc.needs_relation_bits
+    return engine, enc, compiled
+
+
+def test_relation_bits_wire_differential():
+    """Relation-bearing wire traffic: the C++ packer's
+    r_rel_runs/r_rel_bits (built from NATIVE-space verdict tables) equal
+    the Python encoder's (HOST-space tables) on the same wire bytes —
+    the two interners assign different ids post-preload, so this parity
+    also pins the id-space translation in native_relation_tables."""
+    from access_control_srv_tpu.ops.encode import _CAPS_FLOOR
+    from access_control_srv_tpu.srv.relations import RelationTupleStore
+
+    from .utils import URNS, build_request
+
+    engine, enc, compiled = _relation_encoder()
+    doc = "urn:restorecommerce:acs:model:document.Document"
+    store = RelationTupleStore()
+    store.set_rewrite(doc, "viewer",
+                      [("this",), ("computed_userset", "owner")])
+    store.create([
+        (doc, "doc1", "owner", "alice"),
+        (doc, "doc2", "viewer", "bob"),
+        (doc, "doc3", "viewer",
+         {"object": {"entity": "group", "id": "g"}, "relation": "member"}),
+        ("group", "g", "member", "carol"),
+    ])
+    reqs = [
+        build_request(subject_id=s, resource_type=doc, resource_id=r,
+                      action_type=URNS["read"])
+        for s in ("alice", "bob", "carol", "mallory")
+        for r in ("doc1", "doc2", "doc3", ["doc1", "doc3"])
+    ]
+    messages, twins = wire_roundtrip(reqs)
+    nb = enc.encode_wire(
+        messages, relation_tables=enc.native_relation_tables(store)
+    )
+    pb_batch = encode_requests(
+        twins, compiled, caps=_CAPS_FLOOR,
+        relation_tables=store.tables_for(compiled),
+    )
+    assert np.array_equal(nb.eligible, pb_batch.eligible)
+    for name in nb.arrays:
+        assert np.array_equal(nb.arrays[name], pb_batch.arrays[name]), name
+
+    # and through the kernel: wire decisions == the scalar oracle's walk
+    engine.relation_store = store
+    kernel = DecisionKernel(compiled)
+    decision, _, status = kernel.evaluate(nb)
+    n = 0
+    for b, req in enumerate(twins):
+        if not nb.eligible[b] or status[b] != 200:
+            continue
+        assert decision[b] == DEC_CODE[engine.is_allowed(req).decision], b
+        n += 1
+    assert n >= len(reqs) - 1
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_relation_bits_fuzz_matches_python_packer(seed):
+    """Structure-free fuzz: random raw row arrays and random (valid)
+    flat verdict tables — the C++ relation packer must be bit-identical
+    to ops/relation.pack_relation_bitplanes on every case, including the
+    multi-word layout (ebits = 2*nru > 32, forced on the later seeds by
+    wide NR/NI so rows carry >16 distinct instance runs)."""
+    from types import SimpleNamespace
+
+    from access_control_srv_tpu.ops import relation as rel
+    from access_control_srv_tpu.ops.encode import (
+        alloc_row_arrays,
+        owner_bit_layout,
+    )
+
+    _, enc, real_compiled = _relation_encoder()
+    rng = np.random.default_rng(seed)
+    wide = seed >= 2
+    B = int(rng.integers(8, 24)) if wide else int(rng.integers(1, 16))
+    caps = dict(
+        NR=34 if wide else int(rng.integers(1, 8)),
+        NI=48 if wide else int(rng.integers(1, 8)),
+        NP=8, NSUB=8, NACT=4, NOP=2, NOWN=2, NRA=2, NHR=2, NROLE=4,
+        NACLE=2, NACLI=2, NHRR=2,
+    )
+    a = alloc_row_arrays(B, caps)
+    a["r_inst_run"][...] = rng.integers(-1, caps["NR"],
+                                        size=a["r_inst_run"].shape)
+    a["r_inst_valid"][...] = rng.integers(
+        0, 2, size=a["r_inst_valid"].shape).astype(bool)
+    a["r_ent_vals"][...] = rng.integers(-1, 12, size=a["r_ent_vals"].shape)
+    a["r_inst_id"][...] = rng.integers(-1, 12, size=a["r_inst_id"].shape)
+    a["r_subject_id"][...] = rng.integers(-1, 12,
+                                          size=a["r_subject_id"].shape)
+
+    RELV = int(rng.integers(1, 7))
+    # random but VALID flat tables: per-(vocab, plane) sorted unique
+    # object-key segments, plus one globally sorted (row<<32)|subject
+    # membership array over ids drawn from the same [0, 12) pool
+    segs = []
+    for _ in range(2 * RELV):
+        k = int(rng.integers(0, 5))
+        keys = np.unique(
+            (rng.integers(0, 12, size=k).astype(np.int64) << 32)
+            | rng.integers(0, 12, size=k).astype(np.int64)
+        )
+        segs.append(np.sort(keys))
+    obj_offs = np.zeros((2 * RELV + 1,), np.int64)
+    obj_offs[1:] = np.cumsum([s.shape[0] for s in segs])
+    obj_keys = (np.concatenate(segs) if segs
+                else np.zeros((0,), np.int64)).astype(np.int64)
+    pairs = []
+    for row in range(obj_keys.shape[0]):
+        for subj in np.unique(rng.integers(0, 12,
+                                           size=int(rng.integers(0, 4)))):
+            pairs.append((np.int64(row) << 32) | np.int64(subj))
+    tables = {
+        "obj_offs": obj_offs,
+        "obj_keys": obj_keys,
+        "pairs": np.sort(np.array(pairs, np.int64))
+        if pairs else np.zeros((0,), np.int64),
+    }
+
+    fake_compiled = SimpleNamespace(arrays={
+        "relv_path": np.zeros((RELV,), np.int32),
+        "t_rel_idx": np.array([0], np.int32),
+    })
+    ref = rel.pack_relation_bitplanes(a, fake_compiled, tables)
+    enc.compiled = fake_compiled
+    try:
+        got = enc.relation_bits_native(a, B, tables=tables)
+    finally:
+        enc.compiled = real_compiled
+    if wide:
+        nru = ref["r_rel_runs"].shape[1]
+        ebits, epw, _, _ = owner_bit_layout(RELV, nru, 0)
+        assert ebits > 32 and epw == 0, "wide seeds must hit multi-word"
+    np.testing.assert_array_equal(ref["r_rel_runs"], got["r_rel_runs"],
+                                  err_msg=f"seed {seed} runs")
+    np.testing.assert_array_equal(
+        ref["r_rel_bits"], got["r_rel_bits"].view(np.int32),
+        err_msg=f"seed {seed} bits")

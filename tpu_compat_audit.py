@@ -115,8 +115,9 @@ def main() -> int:
     captured = {}
     real_sig_runner = pre._sig_runner
 
-    def capture_sig(schedule, needs_pairs=True, with_hr=False):
-        run = real_sig_runner(schedule, needs_pairs, with_hr)
+    def capture_sig(schedule, needs_pairs=True, with_hr=False,
+                    with_rel=False):
+        run = real_sig_runner(schedule, needs_pairs, with_hr, with_rel)
 
         def wrap(*args):
             captured["sig"] = (run, args)
@@ -169,8 +170,9 @@ def main() -> int:
     captured_hr = {}
     real_sig_runner_hr = pre_hr._sig_runner
 
-    def capture_sig_hr(schedule, needs_pairs=True, with_hr=False):
-        run = real_sig_runner_hr(schedule, needs_pairs, with_hr)
+    def capture_sig_hr(schedule, needs_pairs=True, with_hr=False,
+                       with_rel=False):
+        run = real_sig_runner_hr(schedule, needs_pairs, with_hr, with_rel)
 
         def wrap(*args):
             captured_hr["sig"] = (run, args, with_hr)
@@ -736,8 +738,9 @@ def main() -> int:
     captured_dp: dict = {}
     real_sig_dp = pre_dp._sig_runner
 
-    def capture_dp(schedule, needs_pairs=True, with_hr=False):
-        run = real_sig_dp(schedule, needs_pairs, with_hr)
+    def capture_dp(schedule, needs_pairs=True, with_hr=False,
+                   with_rel=False):
+        run = real_sig_dp(schedule, needs_pairs, with_hr, with_rel)
 
         def wrap(*args):
             captured_dp.setdefault("calls", []).append((run, args))
@@ -1166,7 +1169,7 @@ def main() -> int:
     kern_off = DecisionKernel(compiled_x, dynamic_policies=True,
                               shared_jits=reg_x, explain=False)
     kern_off.evaluate(batch_x)
-    off_key = ("dense", False, with_hr_x)
+    off_key = ("dense", False, with_hr_x, False)  # relation-free fixture
     _, bk_x, ebk_x, padl_x = _lead_padding(batch_x)
     largs_x = (
         kern_off._c,
@@ -1260,6 +1263,113 @@ def main() -> int:
                  "touching the off-key executable; a same-size-class "
                  "shadow candidate reuses every production program — zero "
                  "new XLA compilations, identical capacity class"),
+    })
+
+    # ---- rebac-zero-matmul-program-identity: the ReBAC serving claims
+    # (docs/REBAC.md).  (a) the relation-bearing device program is pure
+    # bit-reading — ZERO dot_general ops in its HLO (the Zanzibar closure
+    # is folded on the host into int32 bitplanes; the kernel only masks
+    # and shifts); (b) relation-tuple CRUD swaps NO program: jit registry
+    # keys, per-key executable caches and the compiled-table version are
+    # all byte-stable across a create/delete cycle that flips the served
+    # decision; (c) two stores on one bus (writer + replicating reader)
+    # converge to byte-identical tuple fingerprints, so replicas keep the
+    # replica-identity guarantee with tuples in the loop.
+    from access_control_srv_tpu.ops.relation import relation_bits_needed
+    from access_control_srv_tpu.srv.events import EventBus
+    from access_control_srv_tpu.srv.relations import RelationTupleStore
+    from tests.utils import URNS as _urns_r
+    from tests.utils import build_request as _build_request_r
+
+    rel_fixture = os.path.join(
+        REPO, "tests", "fixtures", "relation_policies.yml"
+    )
+    doc_r = "urn:restorecommerce:acs:model:document.Document"
+    engine_r = AccessController()
+    populate(engine_r, rel_fixture)
+    compiled_r = compile_policies(engine_r.policy_sets, engine_r.urns)
+    assert compiled_r.supported and relation_bits_needed(compiled_r)
+    store_r = RelationTupleStore()
+    store_r.create([(doc_r, "doc1", "viewer", "alice")])
+    reqs_r = [
+        _build_request_r(subject_id=s, resource_type=doc_r, resource_id=r,
+                         action_type=_urns_r["read"])
+        for s in ("alice", "bob") for r in ("doc1", "doc2")
+    ]
+    batch_r = encode_requests(
+        reqs_r, compiled_r, relation_tables=store_r.tables_for(compiled_r)
+    )
+    dense_r = DecisionKernel(compiled_r)
+    dense_r.evaluate(batch_r)
+    _, bk_r, ebk_r, padl_r = _lead_padding(batch_r)
+    args_r = (
+        {k: jnp.asarray(padl_r(v)) for k, v in batch_r.arrays.items()},
+        jnp.asarray(_pad_cols(batch_r.rgx_set, ebk_r)),
+        jnp.asarray(_pad_cols(batch_r.pfx_neq, ebk_r)),
+        jnp.asarray(_pad_cols(batch_r.cond_true, bk_r)),
+        jnp.asarray(_pad_cols(batch_r.cond_abort, bk_r)),
+        jnp.asarray(_pad_cols(batch_r.cond_code, bk_r)),
+    )
+    hlo_r = jax.jit(
+        lambda *a: dense_r._run(*a)
+    ).lower(*args_r).as_text()
+    dot_generals = hlo_r.count("dot_general")
+
+    # (b) churn under a serving evaluator: decision flips, programs don't
+    ev_r = HybridEvaluator(engine_r)
+    churn_store = RelationTupleStore()
+    ev_r.attach_relation_store(churn_store)
+    probe = reqs_r[2]  # bob / doc1
+    dec_closed = ev_r.is_allowed(probe).decision
+    keys_before_r = set(ev_r._shared_jits)
+    sizes_before_r = {
+        repr(k): f._cache_size() for k, f in ev_r._shared_jits.items()
+    }
+    version_before_r = ev_r._compiled.version
+    churn_store.create([(doc_r, "doc1", "viewer", "bob")])
+    dec_open = ev_r.is_allowed(probe).decision
+    churn_store.delete([(doc_r, "doc1", "viewer", "bob")])
+    dec_reclosed = ev_r.is_allowed(probe).decision
+    sizes_after_r = {
+        repr(k): f._cache_size() for k, f in ev_r._shared_jits.items()
+        if repr(k) in sizes_before_r
+    }
+    churn_ok = (
+        dec_closed == "DENY" and dec_open == "PERMIT"
+        and dec_reclosed == "DENY"
+        and set(ev_r._shared_jits) == keys_before_r
+        and sizes_after_r == sizes_before_r
+        and ev_r._compiled.version == version_before_r
+    )
+    ev_r.shutdown()
+
+    # (c) replica byte-identity with tuples in the loop
+    bus_r = EventBus()
+    writer_r = RelationTupleStore(bus=bus_r)
+    reader_r = RelationTupleStore(bus=bus_r).start_replication()
+    writer_r.set_rewrite(doc_r, "viewer",
+                         [("this",), ("computed_userset", "owner")])
+    for i in range(24):
+        writer_r.create([(doc_r, f"doc{i % 6}", "owner", f"u{i % 4}")])
+    writer_r.delete([(doc_r, "doc0", "owner", "u0")])
+    replica_identical = writer_r.fingerprint() == reader_r.fingerprint()
+
+    rebac_ok = (dot_generals == 0 and churn_ok and replica_identical)
+    results.append({
+        "kernel": "rebac-zero-matmul-program-identity",
+        "ok": bool(rebac_ok),
+        "dot_generals_in_relation_program": dot_generals,
+        "churn_zero_new_xla_compiles": bool(churn_ok),
+        "churn_decision_flip": [dec_closed, dec_open, dec_reclosed],
+        "replica_tuple_fingerprint_identical": bool(replica_identical),
+        "note": ("the relation-bearing dense program contains zero "
+                 "dot_general ops (the Zanzibar closure is host-folded "
+                 "into bitplanes; the device side is the stage-B bit "
+                 "reader); tuple create/delete flips the served decision "
+                 "with jit keys, executable caches and the compiled "
+                 "version all byte-stable; a replicating store converges "
+                 "to the writer's exact tuple fingerprint "
+                 "(docs/REBAC.md)"),
     })
 
     # ---- static-invariants-clean: acs-lint gate over the shipped tree.
