@@ -7,6 +7,14 @@ from .encode import RequestBatch, encode_requests
 from .kernel import DecisionKernel
 from .prefilter import PrefilteredKernel
 from .reverse import ReverseQueryKernel, what_is_allowed_batch
+from .lattice import (
+    CellVerdict,
+    LatticeSpec,
+    SnapshotWriter,
+    diff_snapshots,
+    fold_reverse_query,
+    load_snapshot,
+)
 
 __all__ = [
     "StringInterner",
@@ -18,4 +26,10 @@ __all__ = [
     "PrefilteredKernel",
     "ReverseQueryKernel",
     "what_is_allowed_batch",
+    "CellVerdict",
+    "LatticeSpec",
+    "SnapshotWriter",
+    "diff_snapshots",
+    "fold_reverse_query",
+    "load_snapshot",
 ]

@@ -256,7 +256,16 @@ class MicroBatcher:
     ) -> "Future":
         """Bulk-class submission: a whatIsAllowed reverse query resolved
         with a ReverseQuery.  Only routed here under admission control
-        (srv/service.py keeps the direct caller-thread walk otherwise)."""
+        (srv/service.py keeps the direct caller-thread walk otherwise).
+
+        Deliberately NO decision-cache interaction, in either direction
+        (contrast ``submit`` above): reverse queries resolve with policy
+        trees, not decisions, so there is nothing meaningful to serve
+        from — or insert into — the isAllowed cache, and a bulk audit
+        sweep (srv/audit_sweep.py) walking a whole permission lattice
+        through here must never evict interactive tenants' warm working
+        sets.  Regression-pinned: tests/test_audit_sweep.py
+        ``test_sweep_never_pollutes_decision_cache``."""
         future: Future = Future()
         if self._stopping:
             future.set_result(self._shutdown_result(BULK))

@@ -70,6 +70,7 @@ class CommandInterface:
             "stage_stats": self.stage_stats,
             "faults": self.faults,
             "shadow_status": self.shadow_status,
+            "audit_sweep": self.audit_sweep,
         }.get(name)
         if handler is None:
             return {"error": f"unknown command {name!r}"}
@@ -202,6 +203,18 @@ class CommandInterface:
                 shadow_status = shadow.status()
                 shadow_status.pop("samples", None)  # health stays compact
                 detail["shadow"] = shadow_status
+            audit = getattr(self.worker, "audit", None)
+            if audit is not None:
+                # sweep-job progress: running count + recent job states
+                # (compact — snapshots/diffs stay behind audit_sweep)
+                audit_status = audit.status()
+                audit_status["jobs"] = [
+                    {k: j.get(k) for k in
+                     ("job", "target", "state", "cells_done",
+                      "cells_total", "sheds")}
+                    for j in audit_status.get("jobs", [])
+                ]
+                detail["audit"] = audit_status
             from .faults import REGISTRY as _faults
 
             fault_stats = _faults.stats()
@@ -427,6 +440,48 @@ class CommandInterface:
         if payload.get("drain"):
             shadow.drain(float(payload.get("drain_timeout_s", 5.0)))
         return shadow.status()
+
+    def audit_sweep(self, payload: dict) -> dict:
+        """Permission-lattice audit control (srv/audit_sweep.py,
+        docs/AUDIT.md).  Actions: ``start`` (``target`` production |
+        shadow, optional ``lattice`` axes), ``pause`` / ``resume`` /
+        ``cancel`` (``job``), ``status`` (optional ``job``), ``diff``
+        (``a``/``b`` job ids), ``twin`` (sweep production + the loaded
+        shadow candidate, report lattice diff beside the live-traffic
+        diff).  Absent the ``audit:enabled`` config the subsystem does
+        not exist and every action answers ``{"enabled": false}``."""
+        audit = getattr(self.worker, "audit", None)
+        if audit is None:
+            return {"enabled": False}
+        payload = payload or {}
+        action = payload.get("action", "status")
+        try:
+            if action == "start":
+                job = audit.start_sweep(
+                    target=payload.get("target", "production"),
+                    lattice=payload.get("lattice"),
+                    wait=bool(payload.get("wait")),
+                    wait_timeout=float(payload.get("wait_timeout_s", 600.0)),
+                )
+                return job.status()
+            if action in ("pause", "resume", "cancel"):
+                return getattr(audit, action)(payload["job"])
+            if action == "status":
+                return audit.status(payload.get("job"))
+            if action == "diff":
+                return audit.diff(
+                    payload["a"], payload["b"],
+                    limit=int(payload.get("limit", 4096)),
+                )
+            if action == "twin":
+                return audit.sweep_twin(
+                    lattice=payload.get("lattice"),
+                    wait_timeout=float(payload.get("wait_timeout_s", 600.0)),
+                    diff_limit=int(payload.get("limit", 4096)),
+                )
+        except Exception as err:  # noqa: BLE001 — report, keep serving
+            return {"enabled": True, "error": str(err)}
+        return {"error": f"unknown audit_sweep action {action!r}"}
 
     def stage_stats(self, payload: dict) -> dict:
         """Per-replica stage attribution for cluster benches: the stage

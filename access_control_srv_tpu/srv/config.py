@@ -263,6 +263,31 @@ DEFAULT_CONFIG: dict = {
         # bounded mirror queue (batches); overflow drops + counts
         "queue_batches": 64,
     },
+    # permission-lattice audit sweeps (srv/audit_sweep.py, docs/AUDIT.md).
+    # Disabled by default: the worker builds no manager, no threads, no
+    # command surface — the serving path is byte-identical.  Enabled:
+    # bulk "who-can-do-what" sweeps ride the batcher's BULK class
+    # (admission-paced, never the interactive queue) and stream masked
+    # JSONL + bitmap snapshots under ``out_dir``.
+    "audit": {
+        "enabled": False,
+        # snapshot artifacts land here (JSONL + .bits.npy sidecars)
+        "out_dir": "/tmp/acs-audit",
+        # cells per bulk submission round; bounds sweep memory and the
+        # bulk queue footprint (must stay under admission:max_queue_bulk)
+        "chunk_size": 256,
+        # per-cell future wait before the job fails honestly
+        "cell_timeout_s": 60.0,
+        # shed cells (429/503/504) retry this many times, then land in
+        # the snapshot as INDETERMINATE + shed code
+        "max_retries": 3,
+        # optional extra pacing between chunks on top of bulk_interval
+        "chunk_pause_ms": 0.0,
+        # default lattice axes (ops/lattice.LatticeSpec.from_config
+        # grammar: ints for synthetic stress-shaped axes, or explicit
+        # subject/resource/action lists)
+        "lattice": {"subjects": 16, "resources": 16, "actions": ["read"]},
+    },
     # ReBAC relation tuples (srv/relations.py, docs/REBAC.md).  Disabled
     # by default: no store is built, and relation-bearing policy targets
     # fail closed on every path (oracle and kernel agree).  Enabled: a

@@ -622,6 +622,12 @@ class Telemetry:
         # and the snapshot block absent — unless a shadow is loaded.
         self.shadow_diffs = Counter()
         self.shadow = Counter()
+        # permission-lattice audit sweeps (srv/audit_sweep.py): job
+        # lifecycle (jobs_started/completed/cancelled/failed), progress
+        # (chunks/cells), bulk-class shed/retry counts and diff volume.
+        # Stays empty — and the snapshot block absent — unless the audit
+        # subsystem is enabled and a sweep has run.
+        self.audit = Counter()
         # per-tenant serving events (srv/tenancy.py): decision / shed /
         # cache_hit / cache_miss per tenant id, cardinality-bounded —
         # see TenantCounter
@@ -699,6 +705,9 @@ class Telemetry:
                     "Shadow-evaluation lifecycle events "
                     "(evaluated/dropped/errors)", self.shadow,
                     label="event")
+        reg.counter("acs_audit_events_total",
+                    "Permission-lattice audit-sweep events "
+                    "(srv/audit_sweep.py)", self.audit, label="event")
         reg.gauge("acs_degraded_seconds",
                   "Cumulative seconds the device kernel path has been "
                   "quarantined (srv/watchdog.py)", self._degraded_seconds)
@@ -825,6 +834,9 @@ class Telemetry:
             shadow_diffs = self.shadow_diffs.snapshot()
             if shadow_events or shadow_diffs:
                 out["shadow"] = {**shadow_events, "diffs": shadow_diffs}
+            audit_events = self.audit.snapshot()
+            if audit_events:
+                out["audit"] = audit_events
             if faults_enabled or failpoint_hits:
                 out["failpoints"] = {
                     "enabled": faults_enabled,

@@ -547,9 +547,26 @@ class Worker:
             telemetry=self.telemetry, logger=self.logger,
         )
         self.service.shadow = self.shadow
+
+        # permission-lattice audit sweeps (srv/audit_sweep.py): built
+        # after the shadow so the twin loop can sweep a loaded candidate.
+        # None unless audit:enabled (the default) — no manager, no
+        # threads, no command surface, byte-identical serving path.
+        from . import audit_sweep as audit_mod
+
+        self.audit = audit_mod.from_config(
+            cfg, worker=self,
+            telemetry=self.telemetry, logger=self.logger,
+        )
         return self
 
     def stop(self) -> None:
+        if getattr(self, "audit", None) is not None:
+            # cancel sweeps before the batcher drains: in-flight bulk
+            # futures resolve with the shutdown status and land in the
+            # snapshot as honest sheds, never as fabricated verdicts
+            self.audit.stop()
+            self.audit = None
         if getattr(self, "shadow", None) is not None:
             # stop mirroring before the serving teardown below: the
             # facade tap checks for None, and the shadow owns its own

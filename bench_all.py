@@ -76,7 +76,11 @@ _CONFIG_KNOBS = (
     "SHARD_MUTATIONS", "SHARD_COUNTS", "EXPLAIN_RULES", "EXPLAIN_TOTAL",
     "EXPLAIN_CHUNK", "SHADOW_RULES", "SHADOW_DURATION_S", "SHADOW_WARMUP_S",
     "SHADOW_WARMUP_MAX_S", "SHADOW_DEADLINE_MS", "SHADOW_CLIENTS",
-    "SHADOW_FLIP_EVERY", "SHADOW_QUEUE",
+    "SHADOW_FLIP_EVERY", "SHADOW_QUEUE", "LATTICE_SUBJECTS",
+    "LATTICE_RESOURCES", "LATTICE_ACTIONS", "LATTICE_RULES",
+    "LATTICE_CHUNK", "LATTICE_ORACLE_SAMPLE", "FAIR_RULES",
+    "FAIR_DURATION_S", "FAIR_WARMUP_S", "FAIR_DEADLINE_MS",
+    "FAIR_CLIENTS", "FAIR_CHUNK", "FAIR_SUBJECTS", "FAIR_RESOURCES",
 )
 
 
@@ -3132,6 +3136,261 @@ def bench_rebac_churn():
     )
 
 
+def bench_lattice_sweep():
+    """Bulk who-can-do-what audit sweep (srv/audit_sweep.py +
+    ops/lattice.py, docs/AUDIT.md): a subject x resource x action
+    lattice — default 1k x 1k x 1 — swept through the reverse kernel in
+    bulk-class chunks, materialized as a streamed JSONL snapshot + 2-bit
+    bitmap.  The bar: wall-clock cells/s vs the scalar isAllowed oracle
+    on a sampled cell subset (decisions cross-checked against the
+    bitmap), with ZERO new reverse-kernel programs traced during the
+    timed sweep (both chunk shapes warmed first; program identity is
+    audited end-to-end by tpu_compat_audit audit-sweep-program-identity)."""
+    import copy as _copy
+    import tempfile
+
+    from access_control_srv_tpu.ops.lattice import LatticeSpec, load_bitmap
+    from access_control_srv_tpu.srv.audit_sweep import AuditSweepManager
+    from access_control_srv_tpu.srv.evaluator import HybridEvaluator
+    from access_control_srv_tpu.srv.telemetry import Telemetry
+
+    n_subjects = int(os.environ.get("LATTICE_SUBJECTS", 1000))
+    n_resources = int(os.environ.get("LATTICE_RESOURCES", 1000))
+    n_actions = int(os.environ.get("LATTICE_ACTIONS", 1))
+    n_rules = int(os.environ.get("LATTICE_RULES", 20_000))
+    chunk = int(os.environ.get("LATTICE_CHUNK", 8192))
+    sample_n = int(os.environ.get("LATTICE_ORACLE_SAMPLE", 256))
+
+    actions = ("read", "modify", "create", "delete")[:max(1, n_actions)]
+    spec = LatticeSpec.stress(n_subjects, n_resources, actions=actions)
+    engine, actual_rules = _stress_engine(n_rules)
+    telemetry = Telemetry()
+    evaluator = HybridEvaluator(engine, backend="kernel",
+                                telemetry=telemetry)
+    out_dir = tempfile.mkdtemp(prefix="acs-lattice-bench-")
+    manager = AuditSweepManager(evaluator, out_dir=out_dir,
+                                chunk_size=chunk)
+    try:
+        # warm sweep (untimed): traces every program shape the lattice
+        # dispatches — chunk schedules AND the pow2 miss-row buckets the
+        # plane cache's eviction pattern produces — so the timed sweep
+        # holds zero XLA work
+        t0 = time.perf_counter()
+        warm = manager.start_sweep(spec=spec, wait=True,
+                                   wait_timeout=24 * 3600.0)
+        warm_s = time.perf_counter() - t0
+        assert warm.state == "done", warm.status()
+        kernel = evaluator._rq_kernel
+        programs_before = set(kernel._runs) if kernel is not None else None
+        traces_before = (sum(r._cache_size() for r in kernel._runs.values())
+                         if kernel is not None else None)
+
+        t0 = time.perf_counter()
+        job = manager.start_sweep(spec=spec, wait=True,
+                                  wait_timeout=24 * 3600.0)
+        sweep_s = time.perf_counter() - t0
+        assert job.state == "done", job.status()
+        assert job.sheds == 0
+        if kernel is not None:
+            assert set(kernel._runs) == programs_before, (
+                "the timed sweep traced a new reverse-kernel program"
+            )
+            traces_after = sum(
+                r._cache_size() for r in kernel._runs.values()
+            )
+            assert traces_after == traces_before, (
+                f"the timed sweep added {traces_after - traces_before} "
+                "XLA traces"
+            )
+        cells_per_s = spec.n_cells / sweep_s
+
+        # scalar oracle on an evenly-strided sample: rate comparison +
+        # bitmap decision cross-check (conditional-free stress tree)
+        codes = load_bitmap(job.bitmap_path, spec.n_cells)
+        code_of = {"PERMIT": 1, "DENY": 2}
+        stride = max(1, spec.n_cells // sample_n)
+        sampled = list(range(0, spec.n_cells, stride))[:sample_n]
+        t0 = time.perf_counter()
+        for index in sampled:
+            resp = engine.is_allowed(_copy.deepcopy(spec.request(index)))
+            assert codes[index] == code_of.get(resp.decision, 0), (
+                f"cell {index}: bitmap {codes[index]} vs oracle "
+                f"{resp.decision}"
+            )
+        oracle_s = time.perf_counter() - t0
+        oracle_cells_per_s = len(sampled) / oracle_s
+        speedup = cells_per_s / oracle_cells_per_s
+        return _result(
+            f"lattice sweep {n_subjects}x{n_resources}x{len(actions)} "
+            f"({actual_rules} rules), kernel cells/s",
+            cells_per_s,
+            "cells/s",
+            {
+                "cells": spec.n_cells, "rules": actual_rules,
+                "chunk": chunk, "sweep_s": round(sweep_s, 2),
+                "cold_sweep_s": round(warm_s, 2),
+                "oracle_cells_per_s": round(oracle_cells_per_s, 1),
+                "oracle_sample": len(sampled),
+                "speedup_vs_oracle": round(speedup, 1),
+                "programs_traced_during_sweep": 0,
+                "bar": "full lattice through the reverse kernel with "
+                       "zero new XLA programs in the timed window; "
+                       "sampled cells byte-agree with the scalar oracle",
+            },
+        )
+    finally:
+        manager.stop()
+        evaluator.shutdown()
+
+
+def bench_audit_fairness():
+    """Interactive p99 under a live audit sweep (srv/audit_sweep.py +
+    srv/admission.py): closed-loop interactive clients drive the
+    admission-gated serving facade while a full lattice sweep saturates
+    the BULK class on the same batcher.  The bar (BASELINE.md): admitted
+    interactive p99 stays inside the deadline bound — the sweep rides
+    ``bulk_interval`` fairness, never the interactive queue — while the
+    sweep still makes real progress (cells/s > 0 reported)."""
+    import tempfile
+    import threading as _threading
+
+    from access_control_srv_tpu.models import Attribute, Request, Target, Urns
+
+    urns = Urns()
+    n_rules = int(os.environ.get("FAIR_RULES", 20_000))
+    duration_s = float(os.environ.get("FAIR_DURATION_S", 3.0))
+    warmup_s = float(os.environ.get("FAIR_WARMUP_S", 1.0))
+    deadline_ms = float(os.environ.get("FAIR_DEADLINE_MS", 250.0))
+    clients = int(os.environ.get("FAIR_CLIENTS", 4))
+    chunk = int(os.environ.get("FAIR_CHUNK", 1024))
+    n_subjects = int(os.environ.get("FAIR_SUBJECTS", 512))
+    n_resources = int(os.environ.get("FAIR_RESOURCES", 512))
+
+    out_dir = tempfile.mkdtemp(prefix="acs-fairness-bench-")
+    worker, _, _ = _serving_worker(n_rules, serve_grpc=False, cfg_extra={
+        "decision_cache": {"enabled": False},
+        "admission": {
+            "enabled": True,
+            "deadline_bound_ms": deadline_ms,
+            "min_batch": 8,
+        },
+        "audit": {
+            "enabled": True,
+            "out_dir": out_dir,
+            "chunk_size": chunk,
+            "lattice": {"subjects": n_subjects, "resources": n_resources,
+                        "actions": ["read"]},
+        },
+    })
+    try:
+        assert worker.audit is not None
+
+        def make_request(i):
+            role = f"role-{i % 97}"
+            k = i % 64
+            entity = f"urn:restorecommerce:acs:model:stress{k}.Stress{k}"
+            return Request(
+                target=Target(
+                    subjects=[Attribute(id=urns["role"], value=role),
+                              Attribute(id=urns["subjectID"], value=f"u{i}")],
+                    resources=[Attribute(id=urns["entity"], value=entity),
+                               Attribute(id=urns["resourceID"],
+                                         value=f"r{i}")],
+                    actions=[Attribute(id=urns["actionID"],
+                                       value=urns["read"])],
+                ),
+                context={"resources": [], "subject": {
+                    "id": f"u{i}",
+                    "role_associations": [{"role": role, "attributes": []}],
+                    "hierarchical_scopes": [],
+                }},
+            )
+
+        corpus = [make_request(i) for i in range(512)]
+
+        # deadline-less warmup (bench_overload discipline): first-shape
+        # XLA compiles on BOTH classes must not poison the EWMA — warm
+        # interactive via the facade and bulk via one tiny sweep
+        warm_job = worker.audit.start_sweep(
+            lattice={"subjects": 2, "resources": max(2, chunk // 2),
+                     "actions": ["read"]},
+            wait=True, wait_timeout=24 * 3600.0,
+        )
+        assert warm_job.state == "done"
+        t_end = time.monotonic() + warmup_s
+        i = 0
+        while time.monotonic() < t_end:
+            worker.service.is_allowed(corpus[i % len(corpus)])
+            i += 1
+
+        job = worker.audit.start_sweep()  # config-default lattice
+        stop = _threading.Event()
+        done_lock = _threading.Lock()
+        lats: list[float] = []
+        codes: list[int] = []
+
+        def loop(slot):
+            i, my_l, my_c = slot, [], []
+            while not stop.is_set():
+                t0 = time.monotonic()
+                resp = worker.service.is_allowed(
+                    corpus[i % len(corpus)],
+                    deadline=t0 + deadline_ms / 1e3,
+                )
+                my_l.append((time.monotonic() - t0) * 1e3)
+                my_c.append(resp.operation_status.code)
+                i += clients
+            with done_lock:
+                lats.extend(my_l)
+                codes.extend(my_c)
+
+        threads = [_threading.Thread(target=loop, args=(s,))
+                   for s in range(clients)]
+        cells_at_start = job.status()["cells_done"]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join()
+        window_s = time.perf_counter() - t0
+        sweep_cells = job.status()["cells_done"] - cells_at_start
+        worker.audit.cancel(job.job_id)
+        job.wait(60)
+
+        admitted = sorted(
+            l for l, c in zip(lats, codes) if c == 200
+        )
+        assert admitted, "nothing admitted during the sweep window"
+        p50 = admitted[len(admitted) // 2]
+        p99 = admitted[min(len(admitted) - 1,
+                           int(len(admitted) * 0.99))]
+        shed = sum(1 for c in codes if c != 200)
+        return _result(
+            f"interactive admitted p99 under live audit sweep "
+            f"({n_rules} rules, deadline {deadline_ms:.0f}ms)",
+            p99,
+            "ms",
+            {
+                "admitted": len(admitted), "shed": shed,
+                "p50_ms": round(p50, 2), "clients": clients,
+                "sweep_cells_during_window": sweep_cells,
+                "sweep_cells_per_s": round(sweep_cells / window_s, 1),
+                "deadline_ms": deadline_ms,
+                "bound_ok": bool(p99 <= deadline_ms),
+                "sweep_progressed": bool(sweep_cells > 0),
+                "bar": "admitted interactive p99 <= the deadline bound "
+                       "while the sweep saturates the bulk class AND the "
+                       "sweep makes real progress (no starvation either "
+                       "direction; tests/test_admission.py "
+                       "TestAuditSweepStarvation)",
+            },
+        )
+    finally:
+        worker.stop()
+
+
 HOST_ONLY = {"scalar", "wia", "overload", "cluster-scale", "tenant-scale"}
 
 # ROADMAP carry-over: the evidence rows stamped [cpu-fallback] while the
@@ -3141,7 +3400,7 @@ REFRESH_ONCHIP = [
     "stress-hr", "token-mix", "adapter-mixed", "crud-churn", "serve",
     "serve-latency", "wire-profile", "wire-pipeline", "overload",
     "cluster-scale", "shard-scale", "explain-overhead", "shadow-diff",
-    "rebac-serve", "rebac-churn",
+    "rebac-serve", "rebac-churn", "lattice-sweep", "audit-fairness",
 ]
 ACCEL_OK = True  # cleared by main() when the backend probe fails
 
@@ -3155,7 +3414,8 @@ def main():
                              "crud-churn", "shard-scale", "overload",
                              "degraded-mode", "cluster-scale",
                              "tenant-scale", "explain-overhead",
-                             "shadow-diff", "rebac-serve", "rebac-churn"]
+                             "shadow-diff", "rebac-serve", "rebac-churn",
+                             "lattice-sweep", "audit-fairness"]
     if "refresh-onchip" in which:
         # expand the runlist in place (dedup keeps explicit extras)
         expanded = []
@@ -3254,6 +3514,8 @@ def main():
         "shadow-diff": bench_shadow_diff,
         "rebac-serve": bench_rebac_serve,
         "rebac-churn": bench_rebac_churn,
+        "lattice-sweep": bench_lattice_sweep,
+        "audit-fairness": bench_audit_fairness,
     }
     for name in which:
         row = fns[name]()

@@ -286,6 +286,41 @@ class TestDecisionAuditLog:
         assert token_attrs and token_attrs[0]["value"] == "***"
         assert "sup3rsecret" not in sink.read_text()
 
+    def test_lattice_snapshot_masks_like_the_audit_log(self, tmp_path):
+        """The exported permission-matrix schema (docs/AUDIT.md) obeys
+        the SAME secret-field rule as the decision audit log above: a
+        lattice axis bound to a secret-named attribute URN exports
+        ``***``, cell lines are index-only, and the raw value never
+        appears anywhere in the snapshot file."""
+        from access_control_srv_tpu.ops.lattice import (
+            LatticeSpec,
+            SnapshotWriter,
+            mask_value,
+        )
+        from access_control_srv_tpu.srv.telemetry import (
+            _LOWERED_MASK_FIELDS,
+        )
+
+        # the two layers share one rule set, not two drifting copies
+        for field in _LOWERED_MASK_FIELDS:
+            assert mask_value(f"urn:acs:names:{field}", "sup3rsecret") \
+                == "***"
+        assert mask_value("urn:acs:names:role", "admin") == "admin"
+
+        spec = LatticeSpec(
+            subjects=(("sup3rsecret", "admin"),),
+            resources=(("res0", "urn:restorecommerce:acs:model:a.A"),),
+            actions=("urn:restorecommerce:acs:names:action:read",),
+            subject_id_urn="urn:restorecommerce:acs:names:apiKey",
+        )
+        path = tmp_path / "snap.jsonl"
+        writer = SnapshotWriter(str(path), spec)
+        writer.close()
+        text = path.read_text()
+        assert "sup3rsecret" not in text
+        header = json.loads(text.splitlines()[0])
+        assert header["axes"]["subjects"][0]["id"] == "***"
+
     def test_audit_sampling_zero_emits_nothing(self, tmp_path):
         sink = tmp_path / "audit.jsonl"
         worker = Worker().start(

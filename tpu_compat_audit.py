@@ -1372,6 +1372,75 @@ def main() -> int:
                  "(docs/REBAC.md)"),
     })
 
+    # ---- audit-sweep-program-identity: the permission-lattice audit
+    # engine (srv/audit_sweep.py + ops/lattice.py, docs/AUDIT.md) must
+    # reuse the production reverse-kernel programs byte-identically — a
+    # full lattice sweep traces ZERO new XLA programs once warm (jit
+    # keys, per-key executable caches and the compiled version all
+    # stable across a repeat sweep), and the subsystem's own modules are
+    # host-only (the sweep drives the kernel through the evaluator; the
+    # fold/snapshot/diff layers never touch the device runtime).
+    import tempfile as _tempfile
+
+    from bench_all import _stress_engine as _lattice_engine
+    from access_control_srv_tpu.ops.lattice import LatticeSpec
+    from access_control_srv_tpu.srv.audit_sweep import AuditSweepManager
+
+    engine_a, _ = _lattice_engine(600)  # > REVERSE_MIN_RULES: kernel path
+    prod_a = HybridEvaluator(engine_a, backend="kernel")
+    mgr_a = AuditSweepManager(
+        prod_a, out_dir=_tempfile.mkdtemp(prefix="acs-audit-compat-"),
+        chunk_size=64,
+    )
+    spec_a = LatticeSpec.stress(12, 12)
+    warm_a = mgr_a.start_sweep(spec=spec_a, wait=True, wait_timeout=600)
+    kernel_a = prod_a._rq_kernel
+    sweep_kernel_engaged = (
+        warm_a.state == "done" and kernel_a is not None
+    )
+    if sweep_kernel_engaged:
+        keys_before_a = set(kernel_a._runs)
+        sizes_before_a = {
+            repr(k): f._cache_size() for k, f in kernel_a._runs.items()
+        }
+        version_before_a = kernel_a.compiled.version
+        job_a = mgr_a.start_sweep(spec=spec_a, wait=True, wait_timeout=600)
+        sizes_after_a = {
+            repr(k): f._cache_size() for k, f in kernel_a._runs.items()
+        }
+        sweep_zero_compiles = (
+            job_a.state == "done"
+            and prod_a._rq_kernel is kernel_a
+            and set(kernel_a._runs) == keys_before_a
+            and sizes_after_a == sizes_before_a
+            and kernel_a.compiled.version == version_before_a
+        )
+    else:
+        sweep_zero_compiles = False
+    mgr_a.stop()
+    prod_a.shutdown()
+    host_only_claims = {}
+    for mod_path in ("access_control_srv_tpu/ops/lattice.py",
+                     "access_control_srv_tpu/srv/audit_sweep.py"):
+        src = open(os.path.join(REPO, mod_path)).read()
+        host_only_claims[mod_path] = bool(
+            "acs-lint: host-only" in src and "import jax" not in src
+        )
+    results.append({
+        "kernel": "audit-sweep-program-identity",
+        "ok": bool(sweep_zero_compiles and all(host_only_claims.values())),
+        "sweep_kernel_engaged": bool(sweep_kernel_engaged),
+        "sweep_zero_new_xla_compiles": bool(sweep_zero_compiles),
+        "host_only_modules": host_only_claims,
+        "note": ("a repeat lattice sweep through the wia reverse kernel "
+                 "adds no jit-registry keys, no per-key executable-cache "
+                 "entries and no compiled-version bump — the audit "
+                 "engine rides the SAME compiled programs as interactive "
+                 "whatIsAllowed traffic; ops/lattice.py and "
+                 "srv/audit_sweep.py carry the acs-lint host-only marker "
+                 "and import no device runtime (docs/AUDIT.md)"),
+    })
+
     # ---- static-invariants-clean: acs-lint gate over the shipped tree.
     # The audit's host-only rows (tracing/admission-zero-device-ops)
     # prove specific modules import no device runtime; this row proves
